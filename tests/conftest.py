@@ -1,0 +1,37 @@
+"""Test bootstrap: force an 8-virtual-device CPU topology BEFORE jax
+initializes, so sharding/mesh tests run without TPU hardware
+(the reference's analogue is backend-parametrized AcceleratedTest,
+veles/tests/accelerated_test.py)."""
+
+import os
+import sys
+
+# Must happen before jax (or anything importing jax) initializes a
+# backend.  PALLAS_AXON_POOL_IPS triggers the axon TPU sitecustomize;
+# clearing it keeps tests off the (single-chip) TPU tunnel.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+# The axon sitecustomize imports jax at interpreter start (before this
+# conftest), freezing JAX_PLATFORMS=axon into the live config — override
+# it explicitly; CPU backend init is still lazy so XLA_FLAGS applies.
+if "jax" in sys.modules:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_prng():
+    """Deterministic generators per test."""
+    import veles_tpu.prng as prng
+    prng.reset()
+    yield
+    prng.reset()

@@ -1,0 +1,70 @@
+"""Config tree tests (mirrors reference veles/tests/test_config.py)."""
+
+import pytest
+
+from veles_tpu.config import Config, Tune, get
+
+
+def test_autovivification():
+    c = Config("test")
+    c.a.b.c = 5
+    assert c.a.b.c == 5
+    assert c.a.path_str() == "test.a"
+
+
+def test_update_from_dict():
+    c = Config("test")
+    c.update({"x": 1, "sub": {"y": 2, "deep": {"z": 3}}})
+    assert c.x == 1
+    assert c.sub.y == 2
+    assert c.sub.deep.z == 3
+
+
+def test_update_merges():
+    c = Config("test")
+    c.update({"sub": {"a": 1}})
+    c.update({"sub": {"b": 2}})
+    assert c.sub.a == 1
+    assert c.sub.b == 2
+
+
+def test_as_dict_roundtrip():
+    c = Config("test")
+    tree = {"x": 1, "sub": {"y": [1, 2]}}
+    c.update(tree)
+    assert c.as_dict() == tree
+
+
+def test_protected_keys():
+    c = Config("test")
+    with pytest.raises(AttributeError):
+        setattr(c, "update", 3)
+    with pytest.raises(AttributeError):
+        setattr(c, "keys", 3)
+
+
+def test_get_helper():
+    c = Config("test")
+    assert get(c.never.set, 42) == 42
+    c.x = 7
+    assert get(c.x, 42) == 7
+
+
+def test_tune_leaf():
+    t = Tune(0.01, 0.001, 0.1)
+    assert float(t) == 0.01
+    assert get(t) == 0.01
+
+
+def test_contains_and_keys():
+    c = Config("test")
+    c.alpha = 1
+    assert "alpha" in c
+    assert "beta" not in c
+    assert c.keys() == ["alpha"]
+
+
+def test_get_returns_default_for_vivified_node():
+    c = Config("test")
+    _ = bool(c.typo_node)  # vivifies
+    assert c.get("typo_node", 42) == 42

@@ -1,0 +1,109 @@
+"""Vector host/device protocol tests (mirrors reference memory tests)."""
+
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.backends import Device
+from veles_tpu.memory import Vector
+
+
+@pytest.fixture(scope="module")
+def device():
+    return Device.create("cpu")
+
+
+def test_host_roundtrip():
+    v = Vector(numpy.arange(6, dtype=numpy.float32).reshape(2, 3))
+    assert v.shape == (2, 3)
+    assert v.size == 6
+    assert bool(v)
+    assert numpy.array_equal(v.plain, numpy.arange(6, dtype=numpy.float32))
+
+
+def test_devmem_upload_and_map_read(device):
+    v = Vector(numpy.ones((4, 4), dtype=numpy.float32))
+    v.initialize(device)
+    d = v.devmem
+    assert tuple(d.shape) == (4, 4)
+    # Simulate a jitted step producing a new device value.
+    import jax.numpy as jnp
+    v.devmem = d + 1.0
+    v.map_read()
+    assert (v.mem == 2.0).all()
+
+
+def test_map_write_makes_host_authoritative(device):
+    v = Vector(numpy.zeros(3, dtype=numpy.float32))
+    v.initialize(device)
+    _ = v.devmem
+    v.map_write()
+    v.mem[0] = 5.0
+    assert float(numpy.asarray(v.devmem)[0]) == 5.0
+
+
+def test_device_bytes_accounting(device):
+    base = Vector.total_device_bytes
+    v = Vector(numpy.zeros((16, 16), dtype=numpy.float32))
+    v.initialize(device)
+    _ = v.devmem
+    assert Vector.total_device_bytes >= base + 16 * 16 * 4
+    v.reset()
+    assert Vector.total_device_bytes == base
+
+
+def test_pickle_maps_device_to_host(device):
+    v = Vector(numpy.arange(4, dtype=numpy.float32))
+    v.initialize(device)
+    import jax.numpy as jnp
+    v.devmem = v.devmem * 3
+    v2 = pickle.loads(pickle.dumps(v))
+    assert numpy.array_equal(v2.mem,
+                             numpy.arange(4, dtype=numpy.float32) * 3)
+    # Transient device state is not pickled.
+    assert v2.device is None
+
+
+def test_shallow_pickle(device):
+    v = Vector(numpy.zeros((8, 8), dtype=numpy.float32),
+               shallow_pickle=True)
+    v2 = pickle.loads(pickle.dumps(v))
+    assert v2.mem is None
+
+
+def test_sharded_upload(device):
+    v = Vector(numpy.arange(32, dtype=numpy.float32).reshape(8, 4))
+    v.initialize(device)
+    v.sharding = device.sharding("data")
+    d = v.devmem
+    assert len(d.sharding.device_set) == 8
+    v.map_read()
+    assert numpy.array_equal(
+        v.mem, numpy.arange(32, dtype=numpy.float32).reshape(8, 4))
+
+
+def test_mesh_creation(device):
+    mesh = device.make_mesh({"data": 2, "model": -1})
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["model"] == 4
+
+
+def test_map_read_is_free_when_synced(device):
+    """No repeated HBM->host transfer when nothing changed."""
+    v = Vector(numpy.ones(4, dtype=numpy.float32))
+    v.initialize(device)
+    v.devmem = v.devmem * 2
+    v.map_read()
+    first = v.mem
+    v.map_read()
+    assert v.mem is first  # no re-download
+
+
+def test_shallow_pickle_preserves_metadata(device):
+    v = Vector(numpy.zeros((3, 4), dtype=numpy.float32),
+               shallow_pickle=True)
+    v2 = pickle.loads(pickle.dumps(v))
+    assert v2.mem is None
+    assert v2.shape == (3, 4)
+    assert v2.dtype == numpy.float32

@@ -1,0 +1,79 @@
+"""Bool expression-DAG tests (mirrors reference veles/tests/test_mutable.py)."""
+
+import pickle
+
+from veles_tpu.mutable import Bool
+
+
+def test_plain_value():
+    assert not bool(Bool())
+    assert bool(Bool(True))
+    assert not bool(Bool(False))
+
+
+def test_assignment_preserves_identity():
+    b = Bool(False)
+    ref = b
+    b <<= True
+    assert b is ref
+    assert bool(b)
+
+
+def test_and_or_invert():
+    a, b = Bool(True), Bool(False)
+    assert bool(a & ~b)
+    assert not bool(a & b)
+    assert bool(a | b)
+    assert not bool(~a | b)
+
+
+def test_expression_tracks_sources():
+    a, b = Bool(True), Bool(False)
+    expr = a & ~b
+    assert bool(expr)
+    a <<= False
+    assert not bool(expr)
+    a <<= True
+    b <<= True
+    assert not bool(expr)
+    b <<= False
+    assert bool(expr)
+
+
+def test_nested_expressions():
+    a, b, c = Bool(True), Bool(True), Bool(False)
+    expr = (a & b) | c
+    assert bool(expr)
+    a <<= False
+    assert not bool(expr)
+    c <<= True
+    assert bool(expr)
+
+
+def test_on_true_callback():
+    fired = []
+    b = Bool(False)
+    b.on_true = lambda bb: fired.append("t")
+    b.on_false = lambda bb: fired.append("f")
+    b <<= True
+    b <<= True  # no edge
+    b <<= False
+    assert fired == ["t", "f"]
+
+
+def test_pickle_roundtrip():
+    a, b = Bool(True), Bool(False)
+    expr = a & ~b
+    expr2 = pickle.loads(pickle.dumps(expr))
+    assert bool(expr2)
+
+
+def test_pickle_preserves_shared_sources():
+    a = Bool(True)
+    e1 = a & Bool(True)
+    e2 = ~a
+    both = pickle.loads(pickle.dumps((a, e1, e2)))
+    a2, e12, e22 = both
+    assert bool(e12) and not bool(e22)
+    a2 <<= False
+    assert not bool(e12) and bool(e22)

@@ -1,0 +1,212 @@
+"""Unit graph semantics tests (mirrors reference veles/tests/test_units.py)."""
+
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.memory import Vector
+from veles_tpu.units import TrivialUnit, Unit
+
+
+class Recorder(TrivialUnit):
+    """Appends its name to a shared trace on run."""
+
+    def __init__(self, workflow, trace, **kwargs):
+        super(Recorder, self).__init__(workflow, **kwargs)
+        self.trace = trace
+
+    def run(self):
+        self.trace.append(self.name)
+
+
+def build_chain(wf, trace, names):
+    units = []
+    prev = wf.start_point
+    for n in names:
+        u = Recorder(wf, trace, name=n)
+        u.link_from(prev)
+        prev = u
+    wf.end_point.link_from(prev)
+    return units
+
+
+def test_linear_chain_order():
+    wf = DummyWorkflow()
+    trace = []
+    build_chain(wf, trace, ["a", "b", "c"])
+    wf.initialize()
+    wf.run()
+    assert trace == ["a", "b", "c"]
+
+
+def test_fanout_fanin():
+    wf = DummyWorkflow()
+    trace = []
+    a = Recorder(wf, trace, name="a")
+    b = Recorder(wf, trace, name="b")
+    c = Recorder(wf, trace, name="c")
+    join = Recorder(wf, trace, name="join")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(a)
+    join.link_from(b, c)   # waits for BOTH
+    wf.end_point.link_from(join)
+    wf.initialize()
+    wf.run()
+    assert trace[0] == "a"
+    assert set(trace[1:3]) == {"b", "c"}
+    assert trace[3] == "join"
+    assert trace.count("join") == 1
+
+
+def test_gate_block_stops_propagation():
+    wf = DummyWorkflow()
+    trace = []
+    a = Recorder(wf, trace, name="a")
+    b = Recorder(wf, trace, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    wf.end_point.link_from(b)
+    b.gate_block <<= True
+    wf.initialize()
+    wf.run()
+    assert trace == ["a"]
+
+
+def test_gate_skip_propagates_without_running():
+    wf = DummyWorkflow()
+    trace = []
+    a = Recorder(wf, trace, name="a")
+    b = Recorder(wf, trace, name="b")
+    c = Recorder(wf, trace, name="c")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    b.gate_skip <<= True
+    wf.initialize()
+    wf.run()
+    assert trace == ["a", "c"]
+
+
+def test_link_attrs_mutable_by_reference():
+    wf = DummyWorkflow()
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    a.data = Vector()
+    b.link_attrs(a, "data")
+    assert b.data is a.data
+
+
+def test_link_attrs_immutable_tracks_source():
+    wf = DummyWorkflow()
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    a.count = 5
+    b.link_attrs(a, "count")
+    assert b.count == 5
+    a.count = 9
+    assert b.count == 9
+
+
+def test_link_attrs_rename():
+    wf = DummyWorkflow()
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    a.src_val = 3
+    b.link_attrs(a, ("dst_val", "src_val"))
+    assert b.dst_val == 3
+
+
+def test_demand_unmet_raises_on_initialize():
+    wf = DummyWorkflow()
+    u = TrivialUnit(wf, name="u")
+    u.link_from(wf.start_point)
+    wf.end_point.link_from(u)
+    u.demand("must_have")
+    with pytest.raises(AttributeError):
+        u.initialize()
+    u.must_have = 1
+    u.initialize()  # now fine
+
+
+def test_demand_satisfied_via_link():
+    wf = DummyWorkflow()
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    b.demand("payload")
+    a.payload = 10
+    b.link_attrs(a, "payload")
+    b.initialize()
+    assert b.payload == 10
+
+
+def test_workflow_initialize_requeues_on_demand_order():
+    """Initialize resolves demands satisfied by earlier units'
+    initialize (reference: workflow.py:307-331 requeue)."""
+    wf = DummyWorkflow()
+
+    class Producer(TrivialUnit):
+        def initialize(self, **kwargs):
+            super(Producer, self).initialize(**kwargs)
+            self.out_value = 77
+
+    class Consumer(TrivialUnit):
+        def __init__(self, workflow, **kwargs):
+            super(Consumer, self).__init__(workflow, **kwargs)
+            self.demand("in_value")
+
+        def initialize(self, **kwargs):
+            super(Consumer, self).initialize(**kwargs)
+
+    p = Producer(wf, name="p")
+    c = Consumer(wf, name="c")
+    p.link_from(wf.start_point)
+    c.link_from(p)
+    wf.end_point.link_from(c)
+
+    # Link after producer init sets the attr: consumer demands resolve
+    # on the requeue pass.
+    orig_init = p.initialize
+
+    def init_and_link(**kwargs):
+        orig_init(**kwargs)
+        c.link_attrs(p, ("in_value", "out_value"))
+    p.initialize = init_and_link
+    wf.initialize()
+    assert c.in_value == 77
+
+
+def test_unlink():
+    wf = DummyWorkflow()
+    trace = []
+    a = Recorder(wf, trace, name="a")
+    b = Recorder(wf, trace, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    wf.end_point.link_from(a)
+    b.unlink_from(a)
+    wf.initialize()
+    wf.run()
+    assert trace == ["a"]
+
+
+def test_timing_accounting():
+    wf = DummyWorkflow()
+    trace = []
+    a = Recorder(wf, trace, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    wf.initialize()
+    wf.run()
+    assert a.run_count == 1
+    assert a.run_time >= 0
+
+
+def test_firestarter_resets_unit_stopped():
+    from veles_tpu.plumbing import FireStarter
+    wf = DummyWorkflow()
+    u = TrivialUnit(wf, name="u")
+    u.stopped = True
+    fs = FireStarter(wf, units_to_fire=[u])
+    fs.run()
+    assert not u.stopped

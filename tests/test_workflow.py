@@ -1,0 +1,169 @@
+"""Workflow container/driver tests (mirrors reference
+veles/tests/test_workflow.py)."""
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.mutable import Bool
+from veles_tpu.plumbing import Repeater
+from veles_tpu.result_provider import IResultProvider
+from veles_tpu.units import TrivialUnit
+
+
+class Counter(TrivialUnit):
+    def __init__(self, workflow, **kwargs):
+        super(Counter, self).__init__(workflow, **kwargs)
+        self.count = 0
+
+    def run(self):
+        self.count += 1
+
+
+def test_repeater_loop_terminates_via_gates():
+    """The canonical training-loop shape: repeater → body → decision,
+    looping until the decision flips its Bool
+    (reference loop semantics: units.py gates + plumbing Repeater)."""
+    wf = DummyWorkflow()
+    complete = Bool(False)
+
+    rep = Repeater(wf)
+    body = Counter(wf, name="body")
+
+    class Decision(TrivialUnit):
+        def run(self):
+            if body.count >= 5:
+                self.complete <<= True
+
+    dec = Decision(wf, name="decision")
+    dec.complete = complete
+    rep.link_from(wf.start_point)
+    body.link_from(rep)
+    dec.link_from(body)
+    rep.link_from(dec)          # loop back
+    rep.gate_block = complete   # stop looping when complete
+    wf.end_point.link_from(dec)
+    wf.end_point.gate_block = ~complete
+    wf.initialize()
+    wf.run()
+    assert body.count == 5
+    assert bool(complete)
+
+
+def test_nested_workflow_runs_as_unit():
+    outer = DummyWorkflow(name="outer")
+    trace = []
+
+    class T(TrivialUnit):
+        def run(self):
+            trace.append(self.name)
+
+    from veles_tpu.workflow import Workflow
+    inner = Workflow(outer, name="inner")
+    iu = T(inner, name="inner_unit")
+    iu.link_from(inner.start_point)
+    inner.end_point.link_from(iu)
+
+    before = T(outer, name="before")
+    before.link_from(outer.start_point)
+    inner.link_from(before)
+    after = T(outer, name="after")
+    after.link_from(inner)
+    outer.end_point.link_from(after)
+
+    outer.initialize()
+    outer.run()
+    assert trace == ["before", "inner_unit", "after"]
+
+
+def test_stop_mid_run():
+    wf = DummyWorkflow()
+    rep = Repeater(wf)
+    body = Counter(wf, name="body")
+
+    class Stopper(TrivialUnit):
+        def run(self):
+            if body.count >= 3:
+                self.workflow.stop()
+
+    st = Stopper(wf, name="stopper")
+    rep.link_from(wf.start_point)
+    body.link_from(rep)
+    st.link_from(body)
+    rep.link_from(st)
+    wf.end_point.link_from(st)
+    wf.end_point.gate_block <<= True  # only stop() can finish
+    wf.initialize()
+    wf.run()
+    assert body.count == 3
+
+
+def test_gather_results():
+    wf = DummyWorkflow()
+
+    class Metrics(TrivialUnit, IResultProvider):
+        def get_metric_names(self):
+            return ["accuracy"]
+
+        def get_metric_values(self):
+            return {"accuracy": 0.99}
+
+    m = Metrics(wf, name="metrics")
+    m.link_from(wf.start_point)
+    wf.end_point.link_from(m)
+    wf.initialize()
+    wf.run()
+    assert wf.gather_results() == {"accuracy": 0.99}
+
+
+def test_generate_graph_dot():
+    wf = DummyWorkflow()
+    u = Counter(wf, name="body")
+    u.link_from(wf.start_point)
+    wf.end_point.link_from(u)
+    dot = wf.generate_graph(write_on_disk=False)
+    assert dot.startswith("digraph")
+    assert '"body"' in dot
+    assert "->" in dot
+
+
+def test_checksum_stable():
+    wf1 = DummyWorkflow()
+    wf2 = DummyWorkflow()
+    assert wf1.checksum == wf2.checksum
+
+
+def test_unit_lookup_by_name():
+    wf = DummyWorkflow()
+    u = Counter(wf, name="needle")
+    assert wf["needle"] is u
+
+
+def test_distributable_aggregation():
+    wf = DummyWorkflow()
+
+    class Prod(TrivialUnit):
+        def generate_data_for_slave(self, slave=None):
+            return {"w": 1}
+
+        def apply_data_from_master(self, data):
+            self.got = data
+
+    p = Prod(wf, name="prod")
+    p.link_from(wf.start_point)
+    wf.end_point.link_from(p)
+    data = wf.generate_data_for_slave()
+    assert data == {"prod": {"w": 1}}
+    wf.apply_data_from_master(data)
+    assert p.got == {"w": 1}
+
+
+def test_workflow_pickle_excludes_launcher():
+    """Snapshots must not drag the live launcher (locks/events) along
+    (reference: resume re-attaches the launcher, __main__.py:597-609)."""
+    import pickle
+    wf = DummyWorkflow()
+    u = Counter(wf, name="body")
+    u.link_from(wf.start_point)
+    wf.end_point.link_from(u)
+    u.count = 41
+    wf2 = pickle.loads(pickle.dumps(wf))
+    assert wf2["body"].count == 41
+    assert wf2.launcher is None

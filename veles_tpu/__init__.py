@@ -1,0 +1,22 @@
+"""veles_tpu — a TPU-native distributed deep-learning workflow framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capability surface of the
+Samsung VELES platform (reference: Lyubava/veles): declarative workflow
+graphs of units, a minibatch loader hierarchy, checkpoint/resume,
+distributed training, hyperparameter genetics, ensembles, observability
+services, and a native inference runtime — with the compute path
+expressed as jitted XLA computations over `jax.sharding` meshes instead
+of per-unit OpenCL/CUDA kernels and pickled job shipping.
+"""
+
+__version__ = "0.1.0"
+
+from .config import root, Config, Tune, get  # noqa: F401
+from .mutable import Bool, LinkableAttribute  # noqa: F401
+from .units import Unit, IUnit, TrivialUnit, Container  # noqa: F401
+from .workflow import Workflow  # noqa: F401
+from .plumbing import Repeater, StartPoint, EndPoint, FireStarter  # noqa: F401
+from .memory import Vector, Array  # noqa: F401
+from .launcher import Launcher  # noqa: F401
+from .result_provider import IResultProvider  # noqa: F401
+from . import prng  # noqa: F401

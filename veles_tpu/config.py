@@ -1,0 +1,201 @@
+"""Attribute-path configuration tree.
+
+Capability parity with the reference config system (reference:
+veles/config.py — ``Config:52``, ``root:151``): an auto-vivifying
+attribute tree (``root.loader.minibatch_size = 60``), ``update()`` from
+nested dicts, protected keys, pretty-printing, and site/user override
+layering.  The genetics subsystem wraps leaves in :class:`Tune` to mark
+them optimizable (reference: veles/genetics/config.py:45).
+
+TPU-era additions: the default tree carries engine knobs relevant to
+JAX/XLA (precision, mesh axis names, checkpoint dirs) instead of
+OpenCL/CUDA device settings.
+"""
+
+import os
+import pprint
+
+PROTECTED_KEYS = {"update", "update_unknown", "print_", "keys", "items",
+                  "path_str", "as_dict", "reset"}
+
+
+class Tune(object):
+    """Marks a config leaf as optimizable by the genetics subsystem.
+
+    ``root.lr = Tune(0.01, 0.0001, 0.1)`` declares a gene with the given
+    default and [min, max] range (reference: veles/genetics/config.py:45
+    ``Tuneable``).
+    """
+
+    def __init__(self, default, minv, maxv):
+        self.default = default
+        self.min = minv
+        self.max = maxv
+
+    def __repr__(self):
+        return "Tune(%s, %s, %s)" % (self.default, self.min, self.max)
+
+    # Arithmetic/conversion fall back to the default value so un-tuned
+    # runs behave as if the plain value had been written.
+    def __float__(self):
+        return float(self.default)
+
+    def __int__(self):
+        return int(self.default)
+
+
+class Config(object):
+    """A node in the configuration tree.
+
+    Attribute access auto-vivifies intermediate nodes
+    (reference: veles/config.py:100-107), so
+    ``root.a.b.c = 1`` works without declaring ``a`` or ``b`` first.
+    """
+
+    def __init__(self, path="root"):
+        object.__setattr__(self, "_path", path)
+
+    # -- tree construction -------------------------------------------------
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        child = Config("%s.%s" % (self._path, name))
+        object.__setattr__(self, name, child)
+        return child
+
+    def __setattr__(self, name, value):
+        if name in PROTECTED_KEYS:
+            raise AttributeError(
+                "'%s' is a protected Config key" % name)
+        if isinstance(value, dict) and not name.startswith("_"):
+            node = Config("%s.%s" % (self._path, name))
+            node.update(value)
+            object.__setattr__(self, name, node)
+        else:
+            object.__setattr__(self, name, value)
+
+    # -- dict-ish API ------------------------------------------------------
+
+    def update(self, tree=None, **kwargs):
+        """Deep-merges a nested dict (or kwargs) into this node
+        (reference: veles/config.py ``Config.update``)."""
+        if tree is None:
+            tree = {}
+        merged = dict(tree)
+        merged.update(kwargs)
+        for key, value in merged.items():
+            if isinstance(value, dict):
+                node = getattr(self, key)
+                if not isinstance(node, Config):
+                    node = Config("%s.%s" % (self._path, key))
+                    object.__setattr__(self, key, node)
+                node.update(value)
+            else:
+                setattr(self, key, value)
+        return self
+
+    def keys(self):
+        return [k for k in self.__dict__ if not k.startswith("_")]
+
+    def items(self):
+        return [(k, v) for k, v in self.__dict__.items()
+                if not k.startswith("_")]
+
+    def as_dict(self):
+        out = {}
+        for k, v in self.items():
+            out[k] = v.as_dict() if isinstance(v, Config) else v
+        return out
+
+    def path_str(self):
+        return self._path
+
+    def reset(self):
+        """Drops every child from this node."""
+        for k in self.keys():
+            object.__delattr__(self, k)
+
+    def get(self, name, default=None):
+        """Returns a *set* leaf value or ``default`` — does NOT vivify;
+        previously-vivified empty nodes also yield ``default``."""
+        value = self.__dict__.get(name, default)
+        if isinstance(value, Config):
+            return default
+        return value
+
+    def __contains__(self, name):
+        return name in self.__dict__ and not name.startswith("_")
+
+    def __repr__(self):
+        return "<Config %s: %s>" % (self._path, sorted(self.keys()))
+
+    def print_(self, file=None):
+        pprint.pprint(self.as_dict(), stream=file)
+
+
+def get(value, default=None):
+    """Returns ``default`` if ``value`` is an unset Config node
+    (mirrors the reference's ``veles.config.get`` helper)."""
+    if isinstance(value, Config):
+        return default
+    if isinstance(value, Tune):
+        return value.default
+    return value
+
+
+#: The global configuration root (reference: veles/config.py:151).
+root = Config("root")
+
+root.common.update({
+    "dirs": {
+        "cache": os.path.join(os.path.expanduser("~"), ".veles_tpu/cache"),
+        "datasets": os.environ.get(
+            "VELES_TPU_DATA",
+            os.path.join(os.path.expanduser("~"), ".veles_tpu/datasets")),
+        "snapshots": os.path.join(
+            os.path.expanduser("~"), ".veles_tpu/snapshots"),
+        "events": os.path.join(os.path.expanduser("~"), ".veles_tpu/events"),
+    },
+    "engine": {
+        # "tpu", "cpu", or "auto" — resolved by backends.Device.
+        "backend": os.environ.get("VELES_TPU_BACKEND", "auto"),
+        # Matmul/conv accumulation dtype policy.
+        "precision_type": "float32",
+        # 0: bf16 compute everywhere it is safe; 1: f32 compute;
+        # 2: f32 with highest-precision matmuls (replaces the reference's
+        # plain/Kahan/multipartial summation levels, config.py:244-247 —
+        # on TPU the equivalent knob is matmul precision).
+        "precision_level": 0,
+        "mesh_axes": {"data": "data", "model": "model"},
+        "sync_run": False,
+    },
+    "loader": {
+        "shuffle_limit": -1,
+    },
+    "snapshotter": {
+        "interval": 1,
+        "time_interval": 15.0,
+        "compression": "gz",
+    },
+    "web": {"host": "localhost", "port": 8090},
+    "graphics": {"enabled": False},
+    "trace": {"enabled": False, "dir": None},
+})
+
+
+def _load_site_overrides():
+    """Layered site config: /etc/default/veles_tpu, ~/.veles_tpu/site.py,
+    ./site_config.py — each is executed with ``root`` in scope
+    (reference: veles/config.py:293-307)."""
+    for path in ("/etc/default/veles_tpu",
+                 os.path.join(os.path.expanduser("~"),
+                              ".veles_tpu", "site.py"),
+                 os.path.join(os.getcwd(), "site_config.py")):
+        if os.path.isfile(path):
+            with open(path, "r") as fin:
+                code = fin.read()
+            exec(compile(code, path, "exec"), {"root": root})
+
+
+_load_site_overrides()

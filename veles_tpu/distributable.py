@@ -1,0 +1,109 @@
+"""Pickling base class and the distributed-data contract.
+
+Capability parity with the reference distributable module (reference:
+veles/distributable.py — ``Pickleable:48``, ``Distributable:136``,
+``IDistributable:222``, ``TriviallyDistributable:285``).
+
+The reference ships weights and minibatch indices between master and
+slaves as pickles; the TPU build moves bulk tensor traffic onto XLA
+collectives over ICI (see parallel/), but the *contract* survives as the
+control-plane protocol: what state a unit contributes when a worker
+joins, what it re-applies on elastic reconfiguration, and what it does
+when a worker is dropped.
+"""
+
+import threading
+
+from .logger import Logger
+
+#: Seconds after which a lock acquisition is logged as a suspected
+#: deadlock (reference: distributable.py:139-157, DEADLOCK_TIME=4).
+DEADLOCK_TIME = 4.0
+
+
+class Pickleable(Logger):
+    """Base class whose attributes ending with ``_`` are excluded from
+    pickling and recreated by :meth:`init_unpickled`
+    (reference: distributable.py:48-67)."""
+
+    def __init__(self, **kwargs):
+        super(Pickleable, self).__init__(**kwargs)
+        self.init_unpickled()
+
+    def init_unpickled(self):
+        """Recreates transient (underscore-suffixed) state; called from
+        both ``__init__`` and ``__setstate__``."""
+        self._logger_ = None  # recreated lazily by Logger.logger
+
+    def __getstate__(self):
+        state = {}
+        for key, value in self.__dict__.items():
+            if not key.endswith("_"):
+                state[key] = value
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.init_unpickled()
+
+
+class Distributable(Pickleable):
+    """Adds the deadlock-sniffing data lock and the default (no-op)
+    distribution hooks (reference: distributable.py:136-220)."""
+
+    DEADLOCK_TIME = DEADLOCK_TIME
+
+    def __init__(self, **kwargs):
+        self.negotiates_on_connect = kwargs.get(
+            "negotiates_on_connect", False)
+        super(Distributable, self).__init__(**kwargs)
+
+    def init_unpickled(self):
+        super(Distributable, self).init_unpickled()
+        self._data_lock_ = threading.Lock()
+        self._data_event_ = threading.Event()
+        self._data_event_.set()
+
+    @property
+    def has_data_for_slave(self):
+        """Event gating job production (reference:
+        distributable.py:189-205)."""
+        return self._data_event_.is_set()
+
+    @has_data_for_slave.setter
+    def has_data_for_slave(self, value):
+        if value:
+            self._data_event_.set()
+        else:
+            self._data_event_.clear()
+
+    def wait_for_data_for_slave(self, timeout=DEADLOCK_TIME):
+        if not self._data_event_.wait(timeout):
+            self.warning("possible deadlock: no data for worker after "
+                         "%.1fs in %s", timeout, type(self).__name__)
+            self._data_event_.wait()
+
+    # -- distribution hooks (master side) ----------------------------------
+
+    def generate_data_for_slave(self, slave=None):
+        """State shipped to a joining/requesting worker."""
+        return None
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Aggregation point for worker results."""
+
+    def drop_slave(self, slave=None):
+        """Worker lost: requeue its outstanding work."""
+
+    # -- distribution hooks (worker side) ----------------------------------
+
+    def generate_data_for_master(self):
+        return None
+
+    def apply_data_from_master(self, data):
+        pass
+
+
+class TriviallyDistributable(Distributable):
+    """Unit with no distributed state at all
+    (reference: distributable.py:285)."""

@@ -1,0 +1,34 @@
+"""Framework error types.
+
+Capability parity with the reference error module (reference:
+veles/error.py) — a small vocabulary of failure classes used across the
+framework.
+"""
+
+
+class VelesError(Exception):
+    """Base class for all framework errors."""
+
+
+class Bug(VelesError):
+    """Internal invariant violation — indicates a framework bug."""
+
+
+class BadFormatError(VelesError):
+    """Malformed input data or configuration."""
+
+
+class AlreadyExistsError(VelesError):
+    """Attempt to register a duplicate object."""
+
+
+class NotExistsError(VelesError):
+    """Lookup of an unregistered object."""
+
+
+class MasterSlaveCommunicationError(VelesError):
+    """Control-plane communication failure between coordinator and workers."""
+
+
+class DeviceNotFoundError(VelesError):
+    """Requested accelerator platform is unavailable."""

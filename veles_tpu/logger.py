@@ -1,0 +1,149 @@
+"""Class-scoped logging and structured event timeline.
+
+Capability parity with the reference logger (reference: veles/logger.py —
+``Logger:59``, ``event:264``, ``MongoLogHandler:292``): every framework
+object mixes in :class:`Logger` and gets a per-class logger with colored
+console output, optional file duplication, and an ``event()`` API that
+records begin/end/single timeline spans.
+
+TPU-era change: the MongoDB sink is replaced by a JSONL event sink (one
+record per line under ``root.common.dirs.events``) plus an in-memory ring
+that the web-status service reads; ``jax.profiler`` traces cover the
+on-device side (see services/tracing.py).
+"""
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+
+_COLORS = {
+    logging.DEBUG: "\033[37m",
+    logging.INFO: "\033[32m",
+    logging.WARNING: "\033[33m",
+    logging.ERROR: "\033[31m",
+    logging.CRITICAL: "\033[1;31m",
+}
+_RESET = "\033[0m"
+
+
+class ColorFormatter(logging.Formatter):
+    def format(self, record):
+        msg = super(ColorFormatter, self).format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelno, "")
+            return "%s%s%s" % (color, msg, _RESET)
+        return msg
+
+
+_setup_lock = threading.Lock()
+_configured = [False]
+
+
+def setup_logging(level=logging.INFO, filename=None):
+    """Installs the root handler once (reference: Logger.setup_logging)."""
+    with _setup_lock:
+        if _configured[0]:
+            logging.getLogger().setLevel(level)
+            return
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(ColorFormatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s",
+            datefmt="%H:%M:%S"))
+        rootlog = logging.getLogger()
+        rootlog.addHandler(handler)
+        rootlog.setLevel(level)
+        if filename:
+            fh = logging.FileHandler(filename)
+            fh.setFormatter(logging.Formatter(
+                "%(asctime)s %(levelname).1s %(name)s: %(message)s"))
+            rootlog.addHandler(fh)
+        _configured[0] = True
+
+
+class EventSink(object):
+    """JSONL event-timeline writer (replaces the reference's MongoDB
+    ``events`` collection, logger.py:264-289).
+
+    Records are ``{"name", "phase" (B/E/I), "ts", "pid", **info}`` —
+    loosely chrome://tracing-compatible so they can be merged with
+    ``jax.profiler`` output.
+    """
+
+    def __init__(self, path=None):
+        self._path = path
+        self._file = None
+        self._lock = threading.Lock()
+        self.ring = []
+        self.ring_size = 4096
+
+    def emit(self, record):
+        with self._lock:
+            self.ring.append(record)
+            if len(self.ring) > self.ring_size:
+                del self.ring[:len(self.ring) - self.ring_size]
+            if self._path is not None:
+                if self._file is None:
+                    os.makedirs(os.path.dirname(self._path), exist_ok=True)
+                    self._file = open(self._path, "a")
+                self._file.write(json.dumps(record) + "\n")
+                self._file.flush()
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+_sink = EventSink()
+
+
+def set_event_sink_path(path):
+    global _sink
+    _sink.close()
+    _sink = EventSink(path)
+
+
+def get_event_sink():
+    return _sink
+
+
+class Logger(object):
+    """Mixin granting ``self.debug/info/warning/error`` plus ``event``."""
+
+    def __init__(self, **kwargs):
+        super(Logger, self).__init__()
+        self._logger_ = logging.getLogger(type(self).__name__)
+
+    @property
+    def logger(self):
+        if not hasattr(self, "_logger_") or self._logger_ is None:
+            self._logger_ = logging.getLogger(type(self).__name__)
+        return self._logger_
+
+    def debug(self, msg, *args):
+        self.logger.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self.logger.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self.logger.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self.logger.error(msg, *args)
+
+    def exception(self, msg="", *args):
+        self.logger.exception(msg, *args)
+
+    def event(self, name, etype="single", **info):
+        """Records a timeline event; ``etype`` is ``begin``/``end``/
+        ``single`` (reference: logger.py:264-289)."""
+        phase = {"begin": "B", "end": "E", "single": "I"}[etype]
+        rec = {"name": name, "phase": phase, "ts": time.time(),
+               "pid": os.getpid(), "cls": type(self).__name__}
+        rec.update(info)
+        _sink.emit(rec)

@@ -1,0 +1,306 @@
+"""Host/device array pairs.
+
+Capability parity with the reference memory module (reference:
+veles/memory.py — ``Array:110`` (a.k.a. Vector), ``Watcher:56-107``):
+every tensor a unit owns is a :class:`Vector` pairing a host numpy array
+with a device buffer, moved between the two by an explicit
+``map_read`` / ``map_write`` / ``map_invalidate`` / ``unmap`` protocol
+(reference memory.py:371-384) so host code never observes stale data.
+
+TPU-era mapping:
+
+  * the device buffer is a ``jax.Array`` resident in HBM (the reference's
+    OpenCL zero-copy / CUDA to_device paths, memory.py:408-511, become
+    ``jax.device_put`` with an optional ``NamedSharding`` so one Vector
+    can span a whole mesh);
+  * ``map_read`` pulls device→host only when the device copy is newer;
+    ``map_write`` marks the host copy authoritative; ``unmap`` (or any
+    device access) uploads if needed — same discipline, same names;
+  * device-memory accounting (the reference's ``Watcher`` metaclass)
+    is a class-level byte counter updated on upload/free.
+
+Pickling maps device→host first (reference memory.py:284-292); a
+``shallow_pickle`` flag sends only shape/dtype metadata — used by the
+control plane to describe tensors without shipping them
+(reference memory.py:290-299).
+"""
+
+import threading
+
+import numpy
+
+from .distributable import Pickleable
+
+_accounting_lock = threading.Lock()
+
+
+class Vector(Pickleable):
+    """A host+device array (reference: memory.py:110 ``Array``)."""
+
+    #: Total bytes currently uploaded to devices (reference Watcher).
+    total_device_bytes = 0
+
+    def __init__(self, data=None, shallow_pickle=False):
+        super(Vector, self).__init__()
+        self._mem = None
+        self.shallow_pickle = shallow_pickle
+        self._sharding = None
+        if data is not None:
+            self.mem = data
+
+    def init_unpickled(self):
+        super(Vector, self).init_unpickled()
+        self._devmem_ = None
+        self._device_ = None
+        # Three states: host authoritative (_host_dirty_), device
+        # authoritative with stale host (_host_stale_), or synced
+        # (neither) — repeats of map_read/unmap are then free.
+        self._host_dirty_ = True
+        self._host_stale_ = False
+        self._device_bytes_ = 0
+        self._lock_ = threading.RLock()
+
+    # -- host side ---------------------------------------------------------
+
+    @property
+    def mem(self):
+        return self._mem
+
+    @mem.setter
+    def mem(self, value):
+        with self._lock_:
+            if value is None:
+                self.reset()
+                return
+            self._mem = numpy.ascontiguousarray(value)
+            self._host_dirty_ = True
+            self._host_stale_ = False
+
+    @property
+    def plain(self):
+        """Flattened host view (reference API)."""
+        return self._mem.reshape(-1) if self._mem is not None else None
+
+    @property
+    def shape(self):
+        if self._mem is not None:
+            return self._mem.shape
+        if self._devmem_ is not None:
+            return tuple(self._devmem_.shape)
+        return self.__dict__.get("_shallow_shape")
+
+    @property
+    def dtype(self):
+        if self._mem is not None:
+            return self._mem.dtype
+        if self._devmem_ is not None:
+            return numpy.dtype(self._devmem_.dtype)
+        shallow = self.__dict__.get("_shallow_dtype")
+        return numpy.dtype(shallow) if shallow is not None else None
+
+    @property
+    def size(self):
+        shape = self.shape
+        if shape is None:
+            return 0
+        n = 1
+        for d in shape:
+            n *= d
+        return n
+
+    @property
+    def nbytes(self):
+        if self._mem is not None:
+            return self._mem.nbytes
+        if self._devmem_ is not None:
+            return self._devmem_.size * self._devmem_.dtype.itemsize
+        return 0
+
+    def __bool__(self):
+        return self._mem is not None or self._devmem_ is not None
+
+    __nonzero__ = __bool__
+
+    def __len__(self):
+        shape = self.shape
+        return shape[0] if shape else 0
+
+    def __getitem__(self, key):
+        self.map_read()
+        return self._mem[key]
+
+    def __setitem__(self, key, value):
+        self.map_write()
+        self._mem[key] = value
+
+    def __repr__(self):
+        return "<Vector shape=%s dtype=%s device=%s>" % (
+            self.shape, self.dtype,
+            "yes" if self._devmem_ is not None else "no")
+
+    # -- device side -------------------------------------------------------
+
+    @property
+    def device(self):
+        return self._device_
+
+    @property
+    def sharding(self):
+        return self._sharding
+
+    @sharding.setter
+    def sharding(self, value):
+        with self._lock_:
+            if value is not self._sharding:
+                self._sharding = value
+                # Resharding requires re-upload.
+                if self._devmem_ is not None:
+                    self._host_sync()
+                    self._free_device()
+
+    def initialize(self, device):
+        """Attaches to a device; upload is lazy (reference:
+        memory.py:347)."""
+        with self._lock_:
+            if device is self._device_:
+                return
+            if self._devmem_ is not None:
+                self._host_sync()
+                self._free_device()
+            self._device_ = device
+
+    @property
+    def devmem(self):
+        """The current ``jax.Array`` — uploads host data first if the
+        host copy is authoritative."""
+        with self._lock_:
+            if self._host_dirty_ or self._devmem_ is None:
+                self._upload()
+            return self._devmem_
+
+    @devmem.setter
+    def devmem(self, value):
+        """Accepts a freshly-computed ``jax.Array`` (the output of a
+        jitted step); the device copy becomes authoritative and the
+        host copy stale — no transfer happens until ``map_read``."""
+        with self._lock_:
+            self._account(-self._device_bytes_)
+            self._devmem_ = value
+            self._device_bytes_ = (
+                value.size * value.dtype.itemsize if value is not None
+                else 0)
+            self._account(self._device_bytes_)
+            self._host_dirty_ = False
+            self._host_stale_ = value is not None
+            if value is not None and self._mem is not None and \
+                    tuple(value.shape) != self._mem.shape:
+                self._mem = None
+
+    def _upload(self):
+        import jax
+        if self._mem is None:
+            return
+        data = self._mem
+        if self._sharding is not None:
+            arr = jax.device_put(data, self._sharding)
+        elif self._device_ is not None and \
+                getattr(self._device_, "default_device", None) is not None:
+            arr = jax.device_put(data, self._device_.default_device)
+        else:
+            arr = jax.device_put(data)
+        self._account(-self._device_bytes_)
+        self._devmem_ = arr
+        self._device_bytes_ = arr.size * arr.dtype.itemsize
+        self._account(self._device_bytes_)
+        self._host_dirty_ = False
+        self._host_stale_ = False
+
+    def _host_sync(self):
+        """Device → host only when the device copy is authoritative
+        AND the host copy is stale — repeat calls are free.
+        ``numpy.asarray`` on a jax.Array yields a read-only view, so
+        copy into a writable buffer."""
+        if self._devmem_ is not None and self._host_stale_:
+            self._mem = numpy.array(self._devmem_)
+            self._host_stale_ = False
+
+    def _free_device(self):
+        self._account(-self._device_bytes_)
+        self._device_bytes_ = 0
+        self._devmem_ = None
+        self._host_dirty_ = self._mem is not None
+        self._host_stale_ = False
+
+    @classmethod
+    def _account(cls, delta):
+        with _accounting_lock:
+            cls.total_device_bytes += delta
+
+    # -- map protocol (reference memory.py:371-384) ------------------------
+
+    def map_read(self):
+        """Ensures the host copy reflects the freshest data."""
+        with self._lock_:
+            self._host_sync()
+
+    def map_write(self):
+        """Host copy becomes authoritative; device copy is stale."""
+        with self._lock_:
+            self._host_sync()
+            if self._mem is None and self._devmem_ is not None:
+                self._mem = numpy.array(self._devmem_)
+            self._host_dirty_ = True
+            self._host_stale_ = False
+
+    def map_invalidate(self):
+        """Host copy becomes authoritative WITHOUT downloading first
+        (caller will overwrite everything)."""
+        with self._lock_:
+            self._host_dirty_ = True
+            self._host_stale_ = False
+
+    def unmap(self):
+        """Pushes host data to the device if the host copy is
+        authoritative."""
+        with self._lock_:
+            if self._host_dirty_ and self._mem is not None and (
+                    self._device_ is not None or
+                    self._sharding is not None):
+                self._upload()
+
+    def reset(self, new_mem=None):
+        """Drops all data (reference: memory.py ``reset``)."""
+        with self._lock_:
+            self._free_device()
+            self._mem = None
+            if new_mem is not None:
+                self.mem = new_mem
+
+    # -- pickling ----------------------------------------------------------
+
+    def __getstate__(self):
+        if self.shallow_pickle:
+            # Describe without shipping: no device→host transfer.
+            state = super(Vector, self).__getstate__()
+            state["_mem"] = None
+            state["_shallow_shape"] = self.shape
+            state["_shallow_dtype"] = str(self.dtype) \
+                if self.dtype is not None else None
+            return state
+        self.map_read()
+        return super(Vector, self).__getstate__()
+
+
+#: Reference-compatible alias (veles.memory.Array).
+Array = Vector
+
+
+def assert_addr(*vectors):
+    """No-op on TPU: the reference asserted device-pointer identity for
+    zero-copy aliasing (memory.py / numpy_ext); jax.Arrays are
+    immutable, so aliasing is structural, not address-based."""
+
+
+def roundup(num, align):
+    d = num % align
+    return num if d == 0 else num + (align - d)

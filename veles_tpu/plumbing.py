@@ -1,0 +1,62 @@
+"""Graph-skeleton units.
+
+Capability parity with the reference plumbing units (reference:
+veles/plumbing.py — ``Repeater:17``, ``StartPoint:44``, ``EndPoint:60``,
+``FireStarter:92``).
+"""
+
+from .units import Unit, TrivialUnit
+
+
+class Repeater(TrivialUnit):
+    """Loop entry point: both the start link and the loop-back link feed
+    it, and ``open_gate`` treats ANY single incoming fire as opening
+    (otherwise the first iteration would deadlock waiting for the
+    loop-back edge) — reference: plumbing.py:17-42.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("view_group", "PLUMBING")
+        super(Repeater, self).__init__(workflow, **kwargs)
+
+    def open_gate(self, src):
+        """Any incoming link opens the gate (reference:
+        plumbing.py ``Repeater.open_gate``)."""
+        for s in self._links_from:
+            self._gate_visited_[s] = False
+        return True
+
+
+class StartPoint(TrivialUnit):
+    """The workflow's entry unit (reference: plumbing.py:44)."""
+
+
+class EndPoint(TrivialUnit):
+    """The workflow's exit unit; running it finishes the workflow
+    (reference: plumbing.py:60-88)."""
+
+    def run(self):
+        self.workflow.on_workflow_finished()
+
+    def open_gate(self, src):
+        # Like Repeater: any path reaching the end point finishes the
+        # run — waiting for all branches would deadlock gated branches.
+        for s in self._links_from:
+            self._gate_visited_[s] = False
+        return True
+
+
+class FireStarter(Unit):
+    """Resets the ``stopped`` flag of attached units so a finished
+    sub-graph can run again (reference: plumbing.py:92)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(FireStarter, self).__init__(workflow, **kwargs)
+        self.units_to_fire = list(kwargs.get("units_to_fire", ()))
+
+    def initialize(self, **kwargs):
+        super(FireStarter, self).initialize(**kwargs)
+
+    def run(self):
+        for unit in self.units_to_fire:
+            unit.stopped = False

@@ -1,0 +1,177 @@
+"""Deterministic, state-preserving pseudo-random generators.
+
+Capability parity with the reference PRNG subsystem (reference:
+veles/prng/random_generator.py — ``RandomGenerator:64``, registry
+``get():~40``, seed-from-file CLI ``veles/__main__.py:476-530``; GPU
+xorshift kernels ocl/random.cl, cuda/random.cu).
+
+TPU-era design: every generator owns BOTH
+  * a host-side ``numpy.random.RandomState`` for loader shuffles and
+    weight-init on host, and
+  * a device-side **JAX threefry key chain** — ``jax_key()`` splits a
+    fresh subkey per call, so on-device randomness (dropout, RBM
+    sampling) is reproducible and checkpointable without any custom
+    xorshift kernel: threefry is already a parallel counter-based PRNG
+    that XLA fuses on-chip (replaces ocl/random.cl / cuda/random.cu).
+
+Both halves are captured by ``__getstate__`` so snapshots resume with
+identical randomness — same guarantee the reference makes by pickling
+its generator state.
+"""
+
+import numpy
+
+from .logger import Logger
+
+
+class RandomGenerator(Logger):
+    """A named deterministic generator (reference:
+    prng/random_generator.py:64)."""
+
+    def __init__(self, key):
+        super(RandomGenerator, self).__init__()
+        self.key = key
+        self._seed = None
+        self._state = numpy.random.RandomState()
+        self._jax_key = None
+        self.seed(numpy.frombuffer(b"seed" + bytes([key & 0xFF]),
+                                   dtype=numpy.uint8))
+
+    # -- seeding -----------------------------------------------------------
+
+    @property
+    def seed_value(self):
+        return self._seed
+
+    def seed(self, seed, count=None, dtype=None):
+        """Seeds from an int, array, bytes, or ``file:count:dtype`` spec
+        (reference: __main__.py:476-530 ``_seed_random``)."""
+        if seed is None:
+            # Entropy-seeded, matching the reference's seed(None) →
+            # random-seed behavior (__main__.py:500).
+            import os as _os
+            seed = numpy.frombuffer(_os.urandom(16), dtype=numpy.uint32)
+        if isinstance(seed, str):
+            seed = self._seed_from_spec(seed)
+        if isinstance(seed, (bytes, bytearray)):
+            seed = numpy.frombuffer(seed, dtype=numpy.uint8)
+        if count is not None and dtype is not None and \
+                not isinstance(seed, numpy.ndarray):
+            raise ValueError("count/dtype only apply to file specs")
+        self._seed = seed
+        if isinstance(seed, numpy.ndarray):
+            mixed = numpy.uint32(
+                numpy.bitwise_xor.reduce(
+                    seed.view(numpy.uint8).astype(numpy.uint32) *
+                    numpy.arange(1, seed.nbytes + 1, dtype=numpy.uint32)))
+            self._state = numpy.random.RandomState(
+                seed.view(numpy.uint8).astype(numpy.uint32))
+            jseed = int(mixed)
+        else:
+            self._state = numpy.random.RandomState(seed)
+            jseed = int(seed) & 0xFFFFFFFF
+        # Lazily materialize the jax key — jax may not be importable at
+        # seed time in pure-host tooling contexts.
+        self._jax_seed = jseed ^ (self.key * 0x9E3779B9 & 0xFFFFFFFF)
+        self._jax_key = None
+        return self
+
+    @staticmethod
+    def _seed_from_spec(spec):
+        """Parses ``/dev/urandom:16:uint32``-style seed specs."""
+        parts = spec.split(":")
+        path = parts[0]
+        count = int(parts[1]) if len(parts) > 1 else 16
+        dtype = numpy.dtype(parts[2] if len(parts) > 2 else "uint8")
+        with open(path, "rb") as fin:
+            data = fin.read(count * dtype.itemsize)
+        return numpy.frombuffer(data, dtype=dtype).copy()
+
+    # -- host-side API (numpy semantics) -----------------------------------
+
+    def fill(self, arr, vle_min=-1.0, vle_max=1.0):
+        """Uniform fill in-place (reference API)."""
+        arr[...] = self._state.uniform(
+            low=vle_min, high=vle_max, size=arr.shape).astype(arr.dtype)
+
+    def fill_normal(self, arr, mean=0.0, stddev=1.0):
+        arr[...] = self._state.normal(
+            loc=mean, scale=stddev, size=arr.shape).astype(arr.dtype)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        return self._state.normal(loc=loc, scale=scale, size=size)
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        return self._state.uniform(low=low, high=high, size=size)
+
+    def shuffle(self, arr):
+        self._state.shuffle(arr)
+
+    def permutation(self, x):
+        return self._state.permutation(x)
+
+    def randint(self, low, high=None, size=None):
+        return self._state.randint(low, high=high, size=size)
+
+    def random_sample(self, size=None):
+        return self._state.random_sample(size=size)
+
+    def choice(self, a, size=None, replace=True, p=None):
+        return self._state.choice(a, size=size, replace=replace, p=p)
+
+    # -- device-side API (JAX keyed PRNG) ----------------------------------
+
+    def jax_key(self):
+        """Returns a FRESH subkey each call; the chain advances, and the
+        chain position is part of the checkpointable state."""
+        import jax
+        if self._jax_key is None:
+            self._jax_key = jax.random.PRNGKey(self._jax_seed)
+        self._jax_key, sub = jax.random.split(self._jax_key)
+        return sub
+
+    def peek_jax_key(self):
+        import jax
+        if self._jax_key is None:
+            self._jax_key = jax.random.PRNGKey(self._jax_seed)
+        return self._jax_key
+
+    # -- state -------------------------------------------------------------
+
+    def __getstate__(self):
+        key_bytes = None
+        if self._jax_key is not None:
+            key_bytes = numpy.asarray(self._jax_key).tobytes()
+        return {"key": self.key, "seed": self._seed,
+                "np_state": self._state.get_state(),
+                "jax_seed": self._jax_seed, "jax_key": key_bytes}
+
+    def __setstate__(self, state):
+        super(RandomGenerator, self).__init__()
+        self.key = state["key"]
+        self._seed = state["seed"]
+        self._state = numpy.random.RandomState()
+        self._state.set_state(state["np_state"])
+        self._jax_seed = state["jax_seed"]
+        if state["jax_key"] is not None:
+            import jax
+            self._jax_key = jax.numpy.frombuffer(
+                state["jax_key"], dtype=jax.numpy.uint32)
+        else:
+            self._jax_key = None
+
+
+_generators = {}
+
+
+def get(key=0):
+    """The global generator registry (reference:
+    prng/random_generator.py ``get``)."""
+    gen = _generators.get(key)
+    if gen is None:
+        gen = _generators[key] = RandomGenerator(key)
+    return gen
+
+
+def reset():
+    _generators.clear()

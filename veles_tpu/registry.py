@@ -1,0 +1,57 @@
+"""Class registries.
+
+Capability parity with the reference registries (reference:
+veles/unit_registry.py — ``UnitRegistry:51``, ``MappedUnitRegistry:178``;
+veles/mapped_object_registry.py:36): metaclasses that catalogue every
+concrete subclass for introspection, frontend generation and
+string→class factory lookups (loaders, normalizers, snapshotters).
+"""
+
+from .error import AlreadyExistsError, NotExistsError
+
+
+class UnitRegistry(type):
+    """Metaclass cataloguing every Unit subclass
+    (reference: veles/unit_registry.py:51)."""
+
+    units = set()
+
+    def __init__(cls, name, bases, clsdict):
+        super(UnitRegistry, cls).__init__(name, bases, clsdict)
+        if not clsdict.get("hide_from_registry", False):
+            UnitRegistry.units.add(cls)
+
+    @staticmethod
+    def find(name):
+        for cls in UnitRegistry.units:
+            if cls.__name__ == name:
+                return cls
+        raise NotExistsError("no unit class named %s" % name)
+
+
+class MappedObjectRegistry(type):
+    """Metaclass for string→class factories
+    (reference: veles/mapped_object_registry.py:36).
+
+    Subclass hierarchies set ``MAPPING = "some-name"`` on concrete
+    classes and a class-level ``registry`` dict on the base; lookups go
+    through ``base.registry["some-name"]``.
+    """
+
+    def __init__(cls, name, bases, clsdict):
+        super(MappedObjectRegistry, cls).__init__(name, bases, clsdict)
+        mapping = clsdict.get("MAPPING")
+        if mapping is None:
+            return
+        # Find the registry dict on the nearest base that defines one.
+        for klass in cls.__mro__:
+            registry = klass.__dict__.get("registry")
+            if registry is not None:
+                break
+        else:
+            return
+        if mapping in registry and registry[mapping] is not cls:
+            raise AlreadyExistsError(
+                "MAPPING %r is already taken by %s" %
+                (mapping, registry[mapping]))
+        registry[mapping] = cls

@@ -1,0 +1,18 @@
+"""Results-contribution interface.
+
+Capability parity with the reference result provider (reference:
+veles/result_provider.py — ``IResultProvider:41``): units implementing
+this contribute to the ``--result-file`` metrics JSON gathered by
+``Workflow.gather_results`` (reference: workflow.py:814-836).
+"""
+
+
+class IResultProvider(object):
+    """Mixin marker: implement ``get_metric_names`` and
+    ``get_metric_values``."""
+
+    def get_metric_names(self):
+        raise NotImplementedError()
+
+    def get_metric_values(self):
+        raise NotImplementedError()

@@ -1,0 +1,357 @@
+"""The dataflow unit model.
+
+Capability parity with the reference unit system (reference:
+veles/units.py — ``IUnit:59``, ``Unit:108``, ``TrivialUnit:891``,
+``Container:899``): a workflow is a directed graph of units with
+
+  * **control links** — ``dst.link_from(src)`` (reference units.py:542);
+    a unit runs when ALL of its incoming links have fired
+    (``open_gate``, units.py:512);
+  * **gates** — ``gate_block`` suppresses run+propagation,
+    ``gate_skip`` propagates without running (units.py:279-306); both
+    are lazily-evaluated :class:`~veles_tpu.mutable.Bool` expressions so
+    loop conditions track live state;
+  * **attribute links** — ``dst.link_attrs(src, "weights", ...)``
+    aliases data attributes (units.py:612);
+  * **demands** — ``self.demand("minibatch_data")`` declares required
+    attributes, verified at initialize time (units.py:656).
+
+Execution-model change for TPU: the reference dispatches each unit run
+onto a Twisted thread pool (units.py:473-493) because each unit owns its
+own OpenCL/CUDA kernels.  Here the host graph driver is a synchronous
+work queue owned by the Workflow (thread parallelism would only add
+nondeterminism), and the *device* parallelism comes from XLA: units in
+the training loop contribute pure functions that the workflow fuses into
+a single jitted step (see accelerated_units.py).  Per-unit wall-time
+accounting (units.py:168-194,779) is kept.
+"""
+
+import time
+
+from .config import root, get as config_get
+from .distributable import Distributable
+from .error import Bug
+from .mutable import Bool, LinkableAttribute
+from .registry import UnitRegistry
+
+# Types treated as "mutable" for link_attrs: linking copies the object
+# reference, so src and dst observe the same value forever
+# (reference: units.py:742-754 picks LinkableAttribute only for
+# immutables).
+_MUTABLE_TYPES_CACHE = [None]
+
+
+def _mutable_types():
+    if _MUTABLE_TYPES_CACHE[0] is None:
+        import numpy
+        from .memory import Vector
+        _MUTABLE_TYPES_CACHE[0] = (Vector, Bool, list, dict, set,
+                                   bytearray, numpy.ndarray)
+    return _MUTABLE_TYPES_CACHE[0]
+
+
+class IUnit(object):
+    """The unit contract (reference: units.py:59): ``initialize`` may
+    raise AttributeError to signal unmet demands (the workflow requeues
+    it), ``run`` does one tick of work."""
+
+    def initialize(self, **kwargs):
+        raise NotImplementedError()
+
+    def run(self):
+        raise NotImplementedError()
+
+
+class Unit(Distributable, metaclass=UnitRegistry):
+    """A node in the workflow graph (reference: units.py:108)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.name = kwargs.get("name", type(self).__name__)
+        self.view_group = kwargs.get("view_group", "PLUMBING")
+        self.timings = config_get(root.common.timings, False) or \
+            kwargs.get("timings", False)
+        self._links_from = {}
+        self._links_to = {}
+        self.gate_block = Bool(False)
+        self.gate_skip = Bool(False)
+        self._demanded = set()
+        self._linked_attrs = {}
+        self._workflow = None
+        self._is_initialized = False
+        self._stopped = False
+        self.run_time = 0.0
+        self.run_count = 0
+        super(Unit, self).__init__(**kwargs)
+        if workflow is not None:
+            workflow.add_ref(self)
+
+    def init_unpickled(self):
+        super(Unit, self).init_unpickled()
+        self._gate_visited_ = {}
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def workflow(self):
+        return self._workflow
+
+    @workflow.setter
+    def workflow(self, value):
+        if self._workflow is not None and value is not None \
+                and value is not self._workflow:
+            self._workflow.del_ref(self)
+        self._workflow = value
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    @property
+    def is_standalone(self):
+        return self.workflow.launcher.is_standalone \
+            if self.workflow is not None else True
+
+    @property
+    def is_master(self):
+        return self.workflow is not None and \
+            self.workflow.launcher.is_master
+
+    @property
+    def is_slave(self):
+        return self.workflow is not None and \
+            self.workflow.launcher.is_slave
+
+    @property
+    def stopped(self):
+        """True when this unit or its workflow was stopped; per-unit
+        flag is resettable (FireStarter re-arms finished sub-graphs,
+        reference plumbing.py:92)."""
+        if self._stopped:
+            return True
+        return self.workflow.stopped if self.workflow is not None else False
+
+    @stopped.setter
+    def stopped(self, value):
+        self._stopped = bool(value)
+
+    def __repr__(self):
+        return '<%s "%s">' % (type(self).__name__, self.name)
+
+    # -- control links -----------------------------------------------------
+
+    @property
+    def links_from(self):
+        return self._links_from
+
+    @property
+    def links_to(self):
+        return self._links_to
+
+    def link_from(self, *sources):
+        """Adds control dependencies; self runs after ALL sources fired
+        (reference: units.py:542)."""
+        for src in sources:
+            self._links_from[src] = True
+            src._links_to[self] = True
+            self._gate_visited_.setdefault(src, False)
+        return self
+
+    def unlink_from(self, *sources):
+        for src in sources:
+            self._links_from.pop(src, None)
+            src._links_to.pop(self, None)
+            self._gate_visited_.pop(src, None)
+        return self
+
+    def unlink_all(self):
+        self.unlink_before()
+        self.unlink_after()
+        return self
+
+    def unlink_before(self):
+        for src in tuple(self._links_from):
+            self.unlink_from(src)
+
+    def unlink_after(self):
+        for dst in tuple(self._links_to):
+            dst.unlink_from(self)
+
+    def open_gate(self, src):
+        """Marks the link from ``src`` as fired; True when every
+        incoming link has fired (the gate "opens") — visited flags are
+        then reset for the next loop iteration
+        (reference: units.py:512)."""
+        if src not in self._links_from:
+            raise Bug("open_gate from non-linked unit %s -> %s" %
+                      (src, self))
+        self._gate_visited_[src] = True
+        if all(self._gate_visited_.get(s, False)
+               for s in self._links_from):
+            for s in self._links_from:
+                self._gate_visited_[s] = False
+            return True
+        return False
+
+    # -- attribute links ---------------------------------------------------
+
+    def link_attrs(self, other, *args, two_way=False):
+        """Aliases attributes from ``other`` (reference: units.py:612).
+
+        Each arg is either a name (same on both sides) or a tuple
+        ``(my_name, other_name)``.  Mutable values (Vector, Bool, numpy
+        arrays, containers) are linked by reference; immutables get a
+        live :class:`LinkableAttribute` entry resolved on access.
+        """
+        for arg in args:
+            if isinstance(arg, tuple):
+                mine, theirs = arg
+            else:
+                mine = theirs = arg
+            value = getattr(other, theirs)
+            if isinstance(value, _mutable_types()):
+                setattr(self, mine, value)
+            else:
+                self._linked_attrs[mine] = LinkableAttribute(
+                    other, theirs, two_way=two_way)
+        return self
+
+    def __getattr__(self, name):
+        # Only called when normal lookup fails or for linked attrs
+        # resolved below via __setattr__/__getattribute__ interplay.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        linked = self.__dict__.get("_linked_attrs")
+        if linked and name in linked:
+            return linked[name].get()
+        raise AttributeError("%r has no attribute %r (demanded: %s)" %
+                             (self, name, sorted(self._demanded)
+                              if "_demanded" in self.__dict__ else "?"))
+
+    def __getattribute__(self, name):
+        if not name.startswith("_"):
+            linked = object.__getattribute__(self, "__dict__").get(
+                "_linked_attrs")
+            if linked is not None and name in linked:
+                return linked[name].get()
+        return object.__getattribute__(self, name)
+
+    def __setattr__(self, name, value):
+        if not name.startswith("_"):
+            linked = self.__dict__.get("_linked_attrs")
+            if linked is not None and name in linked:
+                entry = linked[name]
+                if entry.two_way:
+                    entry.set(value)
+                    return
+                # One-way link: local assignment breaks the link
+                # (matches reference property-set semantics for
+                # two_way=False: writes are local).
+                del linked[name]
+        object.__setattr__(self, name, value)
+
+    def demand(self, *attrs):
+        """Declares required attributes (reference: units.py:656); the
+        workflow retries ``initialize`` until they are satisfied."""
+        self._demanded.update(attrs)
+
+    def verify_interface(self):
+        missing = [a for a in sorted(self._demanded)
+                   if not self._has_attr(a)]
+        if missing:
+            raise AttributeError(
+                "%s lacks demanded attribute(s): %s" %
+                (self, ", ".join(missing)))
+
+    def _has_attr(self, name):
+        if name in self._linked_attrs:
+            try:
+                self._linked_attrs[name].get()
+                return True
+            except AttributeError:
+                return False
+        return hasattr(self, name) and getattr(self, name) is not None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self, **kwargs):
+        """Default initialize verifies demands; subclasses extend.
+        May raise AttributeError → the workflow requeues this unit
+        (reference: workflow.py:307-331)."""
+        self.verify_interface()
+        self._is_initialized = True
+
+    def run(self):
+        pass
+
+    def stop(self):
+        """Called on workflow stop for units holding external resources."""
+
+    # -- execution ---------------------------------------------------------
+
+    def _run_timed(self):
+        t0 = time.time()
+        try:
+            self.run()
+        finally:
+            dt = time.time() - t0
+            self.run_time += dt
+            self.run_count += 1
+            if self.timings:
+                self.debug("%s ran in %.3f ms", self.name, dt * 1e3)
+
+    def check_gate_and_run(self, src):
+        """The hot-loop body (reference: units.py:756-777
+        ``_check_gate_and_run``)."""
+        if not self.open_gate(src):
+            return
+        if self.gate_block:
+            return
+        if not self.gate_skip:
+            if self._is_initialized or self.workflow is None:
+                self._run_timed()
+            else:
+                raise Bug("%s run before initialize" % self)
+        self.run_dependent()
+
+    def run_dependent(self):
+        """Schedules all downstream units (reference: units.py:473)."""
+        wf = self.workflow
+        for dst in self._links_to:
+            if wf is not None:
+                wf.schedule(dst, self)
+            else:
+                dst.check_gate_and_run(self)
+
+    # -- distributed aggregation default ----------------------------------
+
+    def apply_data_from_master(self, data):
+        pass
+
+    def generate_data_for_master(self):
+        return None
+
+    # -- pickling ----------------------------------------------------------
+
+    def __getstate__(self):
+        state = super(Unit, self).__getstate__()
+        # Control links are restored by the Workflow's own state; keep
+        # them (they are Unit references which pickle with the graph).
+        return state
+
+
+class TrivialUnit(Unit):
+    """Concrete no-op unit (reference: units.py:891)."""
+
+    def initialize(self, **kwargs):
+        super(TrivialUnit, self).initialize(**kwargs)
+
+    def run(self):
+        pass
+
+
+class Container(Unit):
+    """Marker base for units containing other units
+    (reference: units.py:899)."""
+    hide_from_registry = True

@@ -1,0 +1,386 @@
+"""Workflow: the unit-graph container and host-side run driver.
+
+Capability parity with the reference workflow (reference:
+veles/workflow.py — ``Workflow:78``, ``initialize:286``, ``run:338``,
+``generate_graph:615``, ``checksum:839``): owns the unit set plus
+StartPoint/EndPoint, initializes units in dependency order with
+partial-init requeue (workflow.py:307-331), aggregates the
+IDistributable contract over member units (workflow.py:443-543),
+executes worker jobs (``do_job``, workflow.py:545), renders a Graphviz
+graph, collects per-unit runtime stats (workflow.py:754-812) and results
+JSON (workflow.py:814-836), and identifies itself by a source checksum
+for coordinator/worker matching (workflow.py:839-853).
+
+Execution-model change for TPU: the reference runs units concurrently on
+a Twisted thread pool; here :meth:`run` drives a deterministic FIFO work
+queue on the host — cheap, reproducible, and sufficient because the
+actual compute is inside jitted step functions that XLA parallelizes
+on-device (see accelerated_units.AcceleratedWorkflow, which fuses the
+whole Repeater loop body into one XLA computation per tick).
+"""
+
+import collections
+import hashlib
+import inspect
+import threading
+import time
+
+from .error import Bug
+from .mutable import Bool
+from .plumbing import StartPoint, EndPoint
+from .result_provider import IResultProvider
+from .units import Unit, Container
+
+
+class Workflow(Container):
+    """A directed graph of units (reference: workflow.py:78)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self._units = []
+        self._stopped_b = Bool(False)
+        self._finished_ = threading.Event()
+        self._queue_ = collections.deque()
+        self.result_file = kwargs.get("result_file")
+        super(Workflow, self).__init__(workflow, **kwargs)
+        self.start_point = StartPoint(self)
+        self.end_point = EndPoint(self)
+        self.negotiates_on_connect = True
+        self._sync = kwargs.get("sync", True)
+        self.run_is_blocking = self._sync
+
+    def init_unpickled(self):
+        super(Workflow, self).init_unpickled()
+        self._finished_ = threading.Event()
+        self._queue_ = collections.deque()
+        self._run_time_started_ = time.time()
+
+    # -- ownership ---------------------------------------------------------
+
+    @property
+    def launcher(self):
+        """The owning launcher (walks up through parent workflows)."""
+        parent = self._workflow
+        if parent is None:
+            return None
+        if isinstance(parent, Workflow):
+            return parent.launcher
+        return parent  # a Launcher-like object
+
+    @property
+    def workflow(self):
+        return self._workflow
+
+    @workflow.setter
+    def workflow(self, value):
+        self._workflow = value
+
+    @property
+    def is_main(self):
+        return not isinstance(self._workflow, Workflow)
+
+    @property
+    def units(self):
+        return list(self._units)
+
+    @property
+    def units_in_dependency_order(self):
+        return self._topological_order()
+
+    def add_ref(self, unit):
+        """Registers a unit (reference: workflow.py ``add_ref``)."""
+        if unit is self:
+            raise Bug("a workflow cannot contain itself")
+        if unit not in self._units:
+            self._units.append(unit)
+        unit.workflow = self
+
+    def del_ref(self, unit):
+        if unit in self._units:
+            self._units.remove(unit)
+
+    def __getitem__(self, name):
+        for unit in self._units:
+            if unit.name == name:
+                return unit
+        raise KeyError(name)
+
+    def index_of(self, unit):
+        return self._units.index(unit)
+
+    # -- stopping ----------------------------------------------------------
+
+    @property
+    def stopped(self):
+        return bool(self._stopped_b)
+
+    @stopped.setter
+    def stopped(self, value):
+        self._stopped_b <<= value
+
+    @property
+    def is_running(self):
+        return not self._finished_.is_set()
+
+    # -- initialize --------------------------------------------------------
+
+    def initialize(self, **kwargs):
+        """Initializes units in dependency order; units raising
+        AttributeError (unmet demands) are requeued until a full pass
+        makes no progress (reference: workflow.py:307-331)."""
+        self._is_initialized = True
+        pending = self._topological_order()
+        max_rounds = len(pending) + 2
+        for _ in range(max_rounds):
+            if not pending:
+                break
+            retry = []
+            errors = {}
+            for unit in pending:
+                if unit is self:
+                    continue
+                try:
+                    unit.initialize(**kwargs)
+                except AttributeError as e:
+                    errors[unit] = e
+                    retry.append(unit)
+            if len(retry) == len(pending):
+                details = "; ".join(
+                    "%s: %s" % (u.name, e) for u, e in errors.items())
+                raise AttributeError(
+                    "workflow initialize deadlock — units with unmet "
+                    "demands: %s" % details)
+            pending = retry
+        self.debug("%s initialized (%d units)", self.name,
+                   len(self._units))
+        return self
+
+    def _topological_order(self):
+        """Kahn's algorithm over control links, falling back to insertion
+        order for unlinked units."""
+        units = [u for u in self._units]
+        indeg = {u: 0 for u in units}
+        for u in units:
+            for dst in u.links_to:
+                if dst in indeg:
+                    indeg[dst] += 1
+        queue = collections.deque(
+            u for u in units if indeg[u] == 0)
+        order = []
+        seen = set()
+        while queue:
+            u = queue.popleft()
+            if u in seen:
+                continue
+            seen.add(u)
+            order.append(u)
+            for dst in u.links_to:
+                if dst in indeg:
+                    indeg[dst] -= 1
+                    if indeg[dst] <= 0 and dst not in seen:
+                        queue.append(dst)
+        # Cycles (the Repeater loop) leave units unvisited; append them
+        # in insertion order.
+        for u in units:
+            if u not in seen:
+                order.append(u)
+        return order
+
+    # -- run driver --------------------------------------------------------
+
+    def schedule(self, dst, src):
+        """Enqueues a (unit, fired-from) control event."""
+        self._queue_.append((dst, src))
+
+    def run(self):
+        """Runs the graph to completion (reference: workflow.py:338).
+
+        Deterministic FIFO propagation: StartPoint fires, events are
+        drained until the EndPoint runs (``on_workflow_finished``) or
+        the queue empties.
+        """
+        self._finished_.clear()
+        self.stopped = False
+        self._run_time_started_ = time.time()
+        self.event("workflow_run", "begin", workflow=self.name)
+        self.start_point._run_timed()
+        self.start_point.run_dependent()
+        while self._queue_ and not self._finished_.is_set():
+            dst, src = self._queue_.popleft()
+            dst.check_gate_and_run(src)
+        if not self._finished_.is_set():
+            # Graph drained without reaching the end point — that is a
+            # completed run for loop-less diagnostic graphs.
+            self.on_workflow_finished()
+        self.event("workflow_run", "end", workflow=self.name)
+
+    def on_workflow_finished(self):
+        self._finished_.set()
+        self._queue_.clear()
+        launcher = self.launcher
+        if self.is_main and launcher is not None:
+            launcher.on_workflow_finished()
+
+    def stop(self):
+        """Requests a stop: running loop units observe ``stopped`` and
+        gate out (reference: workflow.py ``stop``)."""
+        self.stopped = True
+        for unit in self._units:
+            if unit is not self:
+                unit.stop()
+        self.on_workflow_finished()
+
+    # -- worker-job execution (control plane) ------------------------------
+
+    def do_job(self, data, update, callback):
+        """Executes one coordinator-issued job on this worker
+        (reference: workflow.py:545): apply master data, run the graph,
+        hand results back."""
+        self.apply_data_from_master(data)
+        if update is not None:
+            self.apply_update_from_master(update)
+        self.run()
+        callback(self.generate_data_for_master())
+
+    # -- IDistributable aggregation over units -----------------------------
+
+    def generate_data_for_slave(self, slave=None):
+        data = {}
+        for unit in self._units:
+            if unit is self:
+                continue
+            piece = unit.generate_data_for_slave(slave)
+            if piece is not None:
+                data[unit.name] = piece
+        return data
+
+    def generate_initial_data_for_slave(self, slave=None):
+        """Handshake-phase data from units with
+        ``negotiates_on_connect`` (reference: workflow.py:565-602)."""
+        data = {}
+        for unit in self._units:
+            if unit is self or not unit.negotiates_on_connect:
+                continue
+            piece = unit.generate_data_for_slave(slave)
+            if piece is not None:
+                data[unit.name] = piece
+        return data
+
+    def apply_data_from_slave(self, data, slave=None):
+        for unit in self._units:
+            if unit is self:
+                continue
+            if data and unit.name in data:
+                unit.apply_data_from_slave(data[unit.name], slave)
+
+    def apply_data_from_master(self, data):
+        for unit in self._units:
+            if unit is self:
+                continue
+            if data and unit.name in data:
+                unit.apply_data_from_master(data[unit.name])
+
+    def apply_update_from_master(self, update):
+        self.apply_data_from_master(update)
+
+    def generate_data_for_master(self):
+        data = {}
+        for unit in self._units:
+            if unit is self:
+                continue
+            piece = unit.generate_data_for_master()
+            if piece is not None:
+                data[unit.name] = piece
+        return data
+
+    def drop_slave(self, slave=None):
+        for unit in self._units:
+            if unit is not self:
+                unit.drop_slave(slave)
+
+    # -- introspection -----------------------------------------------------
+
+    def generate_graph(self, filename=None, write_on_disk=True):
+        """Renders the control graph as Graphviz DOT text
+        (reference: workflow.py:615)."""
+        lines = ["digraph %s {" % type(self).__name__.replace(" ", "_")]
+        ids = {u: "u%d" % i for i, u in enumerate(self._units)}
+        for u in self._units:
+            shape = "rect"
+            if u is self.start_point or u is self.end_point:
+                shape = "circle"
+            lines.append('  %s [label="%s" shape=%s];' %
+                         (ids[u], u.name, shape))
+        for u in self._units:
+            for dst in u.links_to:
+                if dst in ids:
+                    lines.append("  %s -> %s;" % (ids[u], ids[dst]))
+        lines.append("}")
+        text = "\n".join(lines)
+        if write_on_disk and filename is not None:
+            with open(filename, "w") as fout:
+                fout.write(text)
+        return text
+
+    def print_stats(self, top_number=5):
+        """Logs top-N units by accumulated run time
+        (reference: workflow.py:754-812)."""
+        stats = sorted(((u.run_time, u) for u in self._units
+                        if u is not self),
+                       key=lambda p: p[0], reverse=True)
+        total = sum(p[0] for p in stats) or 1e-12
+        self.info("Run time: %.2fs; top units:",
+                  time.time() - self._run_time_started_)
+        for rt, u in stats[:top_number]:
+            self.info("  %-24s %8.3fs (%4.1f%%, %d runs)",
+                      u.name, rt, 100.0 * rt / total, u.run_count)
+
+    def gather_results(self):
+        """Collects metrics from IResultProvider units into a dict
+        (reference: workflow.py:814-836)."""
+        results = {}
+        for unit in self._units:
+            if isinstance(unit, IResultProvider) and unit is not self:
+                names = unit.get_metric_names()
+                values = unit.get_metric_values()
+                if isinstance(values, dict):
+                    results.update(values)
+                else:
+                    for n, v in zip(names, values):
+                        results[n] = v
+        return results
+
+    @property
+    def checksum(self):
+        """SHA1 of the defining source file, for coordinator/worker
+        match verification (reference: workflow.py:839-853)."""
+        try:
+            src = inspect.getsourcefile(type(self))
+            with open(src, "rb") as fin:
+                data = fin.read()
+        except (TypeError, OSError):
+            data = type(self).__name__.encode()
+        return hashlib.sha1(data).hexdigest() + "_" + type(self).__name__
+
+    # -- pickling ----------------------------------------------------------
+
+    def __getstate__(self):
+        """Snapshots exclude the launcher — it holds live process state
+        (locks, events) and is re-attached on resume
+        (reference: __main__.py:597-609)."""
+        state = super(Workflow, self).__getstate__()
+        if not isinstance(state.get("_workflow"), Workflow):
+            state["_workflow"] = None
+        return state
+
+    # -- running as a nested unit ------------------------------------------
+
+    def check_gate_and_run(self, src):
+        if not self.open_gate(src):
+            return
+        if self.gate_block:
+            return
+        if not self.gate_skip:
+            self._run_timed()
+        self.run_dependent()
