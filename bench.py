@@ -1,0 +1,96 @@
+"""Driver benchmark: MNIST784-class FC training throughput on the local
+chip.  Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline note: the reference publishes no benchmark numbers
+(BASELINE.md — `published == {}`); the long-term target is the AlexNet
+config vs single-A100 throughput (BASELINE.json north star), which this
+bench will switch to once the conv stack lands.  Until then
+``vs_baseline`` is computed against A100_MLP_IMG_PER_SEC, a
+public-ballpark single-A100 throughput for this exact MLP shape
+(784-100-10, bf16/f32, batch 100) ≈ 1.5M images/s — i.e. vs_baseline
+is "fraction of a single A100 on the same model".
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+A100_MLP_IMG_PER_SEC = 1.5e6
+
+# MNIST784 geometry (synthetic payload: the bench measures compute
+# throughput, not file IO).
+N_TRAIN = 60000
+N_VALID = 10000
+BATCH = 100
+TICKS_PER_DISPATCH = 120
+
+
+def build():
+    import numpy
+    import veles_tpu.prng as prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+    class SyntheticMnist(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            n = N_TRAIN + N_VALID
+            self.original_data.mem = rng.rand(
+                n, 784).astype(numpy.float32)
+            self.original_labels.mem = rng.randint(
+                0, 10, size=n).astype(numpy.int32)
+            self.class_lengths = [0, N_VALID, N_TRAIN]
+
+    prng.reset()
+    prng.get(0).seed(42)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, layers=(100, 10),
+                       minibatch_size=BATCH,
+                       ticks_per_dispatch=TICKS_PER_DISPATCH,
+                       max_epochs=1000, loader_cls=SyntheticMnist)
+    launcher.initialize()
+    return launcher, wf
+
+
+def main():
+    import jax
+
+    launcher, wf = build()
+    loader, compiler = wf.loader, wf.compiler
+    compiler.compile()
+
+    def run_epoch():
+        start_epoch = loader.epoch_number
+        while loader.epoch_number == start_epoch:
+            loader.run()
+
+    # Warmup epoch: compiles train+validation block programs.
+    run_epoch()
+    # Ensure warmup finished before timing.
+    jax.block_until_ready(
+        next(iter(compiler._param_vecs.values())).devmem)
+
+    epochs = 3
+    t0 = time.time()
+    for _ in range(epochs):
+        run_epoch()
+    jax.block_until_ready(
+        next(iter(compiler._param_vecs.values())).devmem)
+    dt = time.time() - t0
+
+    images = epochs * (N_TRAIN + N_VALID)
+    ips = images / dt
+    print(json.dumps({
+        "metric": "mnist784_fc_train_images_per_sec",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / A100_MLP_IMG_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

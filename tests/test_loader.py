@@ -1,0 +1,130 @@
+"""Loader contract tests (mirrors reference loader tests)."""
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader.base import Loader, TEST, VALID, TRAIN
+
+
+class ToyLoader(Loader):
+    """60 train / 20 validation / 10 test synthetic samples."""
+
+    def __init__(self, workflow, **kwargs):
+        super(ToyLoader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        self.class_lengths = [10, 20, 60]
+
+    def create_minibatch_data(self):
+        pass
+
+
+def make_loader(**kwargs):
+    wf = DummyWorkflow()
+    loader = ToyLoader(wf, minibatch_size=kwargs.pop("minibatch_size", 16),
+                       **kwargs)
+    loader.initialize()
+    return loader
+
+
+def test_class_walk_order_and_flags():
+    loader = make_loader()
+    classes = []
+    lasts = 0
+    for _ in range(6):  # 1 test(10) + 2 valid(20) + 4 train(60) = ceil
+        loader.serve_next_minibatch()
+        classes.append(loader.minibatch_class)
+        lasts += loader.last_minibatch
+    assert classes[0] == TEST
+    assert VALID in classes
+    assert classes[-1] == TRAIN
+
+
+def test_epoch_accounting():
+    loader = make_loader(minibatch_size=10)
+    # 10 test + 20 valid + 60 train = 90 samples = 9 minibatches/epoch
+    for i in range(9):
+        loader.serve_next_minibatch()
+    assert loader.epoch_ended
+    assert loader.epoch_number == 1
+    loader.serve_next_minibatch()
+    assert loader.minibatch_class == TEST
+    assert not loader.epoch_ended
+
+
+def test_partial_minibatch_padded_with_mask():
+    loader = make_loader(minibatch_size=16)
+    loader.serve_next_minibatch()  # test class: 10 samples < 16
+    assert loader.minibatch_size == 10
+    assert loader.minibatch_indices.mem.shape == (16,)
+    assert loader.minibatch_mask.mem.sum() == 10
+
+
+def test_train_shuffled_validation_not():
+    prng.get(0).seed(3)
+    loader = make_loader(minibatch_size=90)
+    first = None
+    # Walk one full epoch to trigger reshuffle.
+    for _ in range(3):
+        loader.serve_next_minibatch()
+        if loader.minibatch_class == TRAIN and first is None:
+            first = numpy.array(loader.minibatch_indices.mem[:60])
+    for _ in range(3):
+        loader.serve_next_minibatch()
+        if loader.minibatch_class == TRAIN:
+            second = numpy.array(loader.minibatch_indices.mem[:60])
+    assert not numpy.array_equal(first, second)  # reshuffled
+    assert set(first) == set(second) == set(range(30, 90))
+
+
+def test_failed_minibatch_requeue():
+    loader = make_loader(minibatch_size=10)
+    served = loader.generate_data_for_slave(slave="w1")
+    indices = served["indices"]
+    loader.drop_slave("w1")
+    assert loader.failed_minibatches
+    requeued = loader.serve_next_minibatch()
+    assert numpy.array_equal(requeued, indices)
+
+
+def test_pickle_requeues_pending():
+    import pickle
+    loader = make_loader(minibatch_size=10)
+    loader.generate_data_for_slave(slave="w1")
+    blob = pickle.dumps(loader)
+    # NOTE: unpickling a Unit detaches it from the workflow; state only.
+    state = pickle.loads(blob)
+    assert len(state.failed_minibatches) == 1
+
+
+def test_master_slave_index_roundtrip():
+    master = make_loader(minibatch_size=10)
+    slave = make_loader(minibatch_size=10)
+    job = master.generate_data_for_slave(slave="w1")
+    slave.apply_data_from_master(job)
+    assert numpy.array_equal(
+        slave.minibatch_indices.mem, master.minibatch_indices.mem)
+    assert slave.minibatch_class == master.minibatch_class
+
+
+def test_train_ratio():
+    loader = make_loader(train_ratio=0.5)
+    assert loader.class_lengths[TRAIN] == 30
+
+
+def test_failed_minibatch_keeps_class():
+    """A requeued validation batch must be re-served as VALIDATION even
+    if the walk has moved into TRAIN (retries carry their class)."""
+    loader = make_loader(minibatch_size=10)
+    loader.serve_next_minibatch()          # TEST
+    job = loader.generate_data_for_slave(slave="w1")  # VALID batch
+    assert job["minibatch_class"] == VALID
+    for _ in range(3):
+        loader.serve_next_minibatch()      # advance into TRAIN
+    assert loader.minibatch_class == TRAIN
+    loader.drop_slave("w1")
+    loader.serve_next_minibatch()          # the retry
+    assert loader.minibatch_class == VALID
+    assert not loader.last_minibatch

@@ -1,0 +1,119 @@
+"""MNIST784 end-to-end accuracy gate — parity config #1
+(BASELINE.json: MNIST784 val-accuracy parity)."""
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.launcher import Launcher
+from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+
+@pytest.fixture(scope="module")
+def trained():
+    prng.reset()
+    prng.get(0).seed(1234)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=8, learning_rate=0.1)
+    launcher.initialize()
+    launcher.run()
+    return wf
+
+
+def test_training_converges(trained):
+    results = trained.gather_results()
+    # Digits-fallback gate: an FC net must reach <10% validation error
+    # within 8 epochs (typically ~4%).
+    assert results["min_validation_err"] < 0.10
+    assert results["min_train_err"] < 0.05
+    assert results["epochs"] == 8
+
+
+def test_step_fused_single_computation(trained):
+    """The whole tick ran as ONE jitted step: forward units never ran
+    standalone compute (their run() hits the fused executor)."""
+    compiler = trained.compiler
+    assert compiler._compiled
+    assert len(compiler.forward_units) == 4  # loader, fc0, fc1, evaluator
+    assert len(compiler.gd_map) == 2
+
+
+def test_momentum_state_updated(trained):
+    gd = trained.gds[0]
+    vel = gd.tstate["velocity_weights"]
+    vel.map_read()
+    assert numpy.abs(vel.mem).max() > 0
+
+
+def test_reproducibility():
+    """Same seed → identical training trajectory (reference guarantee:
+    deterministic PRNG, prng/random_generator.py)."""
+    errs = []
+    for _ in range(2):
+        prng.reset()
+        prng.get(0).seed(77)
+        launcher = Launcher()
+        wf = MnistWorkflow(launcher, max_epochs=2, learning_rate=0.1)
+        launcher.initialize()
+        launcher.run()
+        errs.append(wf.gather_results()["min_validation_err"])
+    assert errs[0] == errs[1]
+
+
+def test_block_mode_matches_single_tick():
+    """lax.scan block dispatch must reproduce single-tick training."""
+    errs = {}
+    for ticks in (1, 8):
+        prng.reset()
+        prng.get(0).seed(1234)
+        launcher = Launcher()
+        wf = MnistWorkflow(launcher, max_epochs=3, learning_rate=0.1,
+                           ticks_per_dispatch=ticks)
+        launcher.initialize()
+        launcher.run()
+        errs[ticks] = wf.gather_results()["min_validation_err"]
+    assert errs[1] == errs[8]
+
+
+def test_dp_sharding_8_devices():
+    """Data-parallel MNIST on the virtual 8-device mesh — parity
+    config #5 (distributed MNIST → mesh data parallelism)."""
+    import jax
+    from veles_tpu.parallel import make_mesh, apply_dp_sharding
+    prng.reset()
+    prng.get(0).seed(1234)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, minibatch_size=96, max_epochs=3,
+                       learning_rate=0.1)
+    launcher.initialize()
+    mesh = make_mesh(jax.devices(), {"data": 8})
+    apply_dp_sharding(wf, mesh)
+    launcher._finished.clear()
+    wf.run()
+    results = wf.gather_results()
+    assert results["min_validation_err"] < 0.15
+    some_param = next(iter(wf.compiler._param_vecs.values()))
+    assert len(some_param.devmem.sharding.device_set) == 8
+
+
+def test_pickle_resume_continues_training():
+    """Snapshot-resume with raised max_epochs must keep training
+    (stop condition re-evaluated at initialize, reference
+    workflow.py:326-328)."""
+    import pickle
+    prng.reset()
+    prng.get(0).seed(5)
+    l1 = Launcher()
+    wf = MnistWorkflow(l1, max_epochs=2, learning_rate=0.1)
+    l1.initialize()
+    l1.run()
+    blob = pickle.dumps(wf)
+    wf2 = pickle.loads(blob)
+    l2 = Launcher()
+    l2.add_ref(wf2)
+    wf2.decision.max_epochs = 4
+    l2.initialize()
+    l2._finished.clear()
+    wf2.run()
+    r = wf2.gather_results()
+    assert r["epochs"] == 4

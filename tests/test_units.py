@@ -210,3 +210,12 @@ def test_firestarter_resets_unit_stopped():
     fs = FireStarter(wf, units_to_fire=[u])
     fs.run()
     assert not u.stopped
+
+
+def test_znicz_mapped_registries():
+    from veles_tpu.znicz.nn_units import (ForwardUnitRegistry,
+                                          GDUnitRegistry, gd_for)
+    from veles_tpu.znicz import All2AllTanh, GDTanh
+    assert ForwardUnitRegistry.registry["all2all_tanh"] is All2AllTanh
+    assert gd_for(All2AllTanh) is GDTanh
+    assert gd_for("softmax").__name__ == "GDSoftmax"

@@ -1,0 +1,530 @@
+"""Accelerated units and the fused-step compiler.
+
+Capability parity with the reference acceleration layer (reference:
+veles/accelerated_units.py — ``AcceleratedUnit:126``,
+``AcceleratedWorkflow:820``, kernel build/cache machinery ``:503-666``;
+veles/backends.py device dispatch).
+
+The reference's model: every unit carries THREE implementations
+(``numpy_run``/``ocl_run``/``cuda_run``), compiles its own kernels at
+initialize, and the workflow tick is a chain of kernel enqueues with
+host synchronization at every Vector map/unmap.
+
+The TPU-native model inverts this: a unit in the training loop is a
+**TracedUnit** that contributes a *pure function* over tracers, and the
+workflow fuses loader-gather → forward stack → loss → backward →
+optimizer updates into ONE jitted XLA computation per tick
+(BASELINE.json north star).  Data flow between traced units is derived
+from shared :class:`~veles_tpu.memory.Vector` identity — ``link_attrs``
+already aliases the same Vector object on both sides, so the compiler
+keys its tensor bag by ``id(vector)`` and no string plumbing is needed.
+Backward passes come from ``jax.value_and_grad`` over the composed
+forward instead of hand-written per-layer gradient kernels; per-layer
+GradientDescent units keep their identity (hyperparameters, momentum
+state, update rule) and are applied inside the same jit.
+
+The reference's per-device compiled-program tar cache
+(accelerated_units.py:599-666) maps to XLA's persistent compilation
+cache, enabled in :func:`enable_compilation_cache`.
+"""
+
+import os
+
+from .config import root, get as config_get
+from .memory import Vector
+from .units import Unit
+from .workflow import Workflow
+
+_cache_enabled = [False]
+
+
+def enable_compilation_cache():
+    """Persistent XLA compile cache (replaces the reference's tar.gz
+    program cache keyed by device, accelerated_units.py:599-666)."""
+    if _cache_enabled[0]:
+        return
+    cache_dir = config_get(root.common.dirs.cache)
+    if cache_dir:
+        import jax
+        os.makedirs(cache_dir, exist_ok=True)
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+        except Exception:  # older/newer jax without the knob
+            pass
+    _cache_enabled[0] = True
+
+
+class StepContext(object):
+    """Per-tick traced context handed to every TracedUnit: the RNG key,
+    the training flag, the scalar loss slot, and the metrics dict."""
+
+    def __init__(self, key=None, training=True):
+        self.key = key
+        self.training = training
+        self.loss = None
+        self.metrics = {}
+        self._key_uses = 0
+
+    def next_key(self):
+        import jax
+        if self.key is None:
+            raise ValueError("step was compiled without an RNG key")
+        self._key_uses += 1
+        return jax.random.fold_in(self.key, self._key_uses)
+
+    def add_metric(self, name, value):
+        self.metrics[name] = value
+
+    def set_loss(self, value):
+        self.loss = value
+
+
+class AcceleratedUnit(Unit):
+    """A unit owning device-resident Vectors (reference:
+    accelerated_units.py:126).  ``initialize`` binds the device and
+    attaches every Vector attribute to it."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(AcceleratedUnit, self).__init__(workflow, **kwargs)
+        self.intermediate_sync = False
+
+    def init_unpickled(self):
+        super(AcceleratedUnit, self).init_unpickled()
+        self._device_ = None
+
+    @property
+    def device(self):
+        return self._device_
+
+    @device.setter
+    def device(self, value):
+        self._device_ = value
+
+    def initialize(self, device=None, **kwargs):
+        super(AcceleratedUnit, self).initialize(**kwargs)
+        if device is not None:
+            self._device_ = device
+        for vec in self._own_vectors():
+            vec.initialize(self._device_)
+
+    def _own_vectors(self):
+        return [v for v in self.__dict__.values()
+                if isinstance(v, Vector)]
+
+
+class TracedUnit(AcceleratedUnit):
+    """A unit participating in the fused jitted step.
+
+    Subclasses implement :meth:`tforward` and declare their tensors:
+
+      * ``trainables``  — attr → Vector, differentiated + updated;
+      * ``tstate``      — attr → Vector, carried/updated but NOT
+        differentiated (optimizer slots, batch-norm stats, …);
+      * inputs/outputs — ordinary Vector attributes read/written via
+        the ``read``/``write`` callbacks inside ``tforward``.
+
+    ``run()`` delegates to the workflow's fused-step executor; the
+    first traced unit reached in a tick triggers the single compiled
+    step, the rest are no-ops (their compute already happened inside
+    that step).
+    """
+
+    hide_from_registry = True
+
+    @property
+    def trainables(self):
+        return {}
+
+    @property
+    def tstate(self):
+        return {}
+
+    def tforward(self, read, write, params, ctx, state=None):
+        """Pure traced computation.  ``read(vec)``/``write(vec, val)``
+        move tracers through the tensor bag; ``params`` maps this
+        unit's trainable attr names to tracers; ``state`` maps this
+        unit's tstate attr names to tracers (None when the unit has
+        none); return a dict of state updates (or None).  ``ctx`` is
+        the :class:`StepContext`."""
+        raise NotImplementedError()
+
+    def run(self):
+        wf = self.workflow
+        if isinstance(wf, AcceleratedWorkflow) and wf.fused:
+            wf.execute_step(trigger=self)
+        else:
+            self.eager_run()
+
+    def eager_run(self):
+        """Single-unit eager forward (inference/debugging path — the
+        reference's numpy_run analogue)."""
+        ctx = StepContext(training=False)
+
+        def read(vec):
+            return vec.devmem
+
+        def write(vec, val):
+            vec.devmem = val
+
+        params = {a: v.devmem for a, v in self.trainables.items()}
+        state = {a: v.devmem for a, v in self.tstate.items()}
+        upd = self.tforward(read, write, params, ctx,
+                            state=state or None) or {}
+        for a, val in upd.items():
+            self.tstate[a].devmem = val
+
+
+class StepCompiler(object):
+    """Builds the fused jitted train step for an AcceleratedWorkflow.
+
+    The compiled function signature is::
+
+        step(params, states, batch, key) ->
+            (new_params, new_states, outputs, metrics)
+
+    where ``params``/``states`` are dicts keyed by "unit_name/attr",
+    ``batch`` is a dict keyed by id(Vector) as str for the loader-fed
+    vectors, ``outputs`` are persisted evaluator output vectors.
+    Donation of ``params``/``states`` makes updates in-place in HBM.
+    """
+
+    def __init__(self, workflow):
+        self.workflow = workflow
+        self.forward_units = []
+        self.gd_map = {}          # forward unit -> gd unit
+        self.batch_vectors = []   # Vectors fed from host each tick
+        self.const_vectors = []   # large device-resident constants
+        self.persist_vectors = []  # evaluator outputs etc.
+        self._compiled = None
+        self._fingerprint = None
+
+    # -- graph analysis ----------------------------------------------------
+
+    def analyze(self):
+        from .znicz.nn_units import GradientDescentBase
+        wf = self.workflow
+        order = wf.units_in_dependency_order
+        self.forward_units = [
+            u for u in order
+            if isinstance(u, TracedUnit) and
+            not isinstance(u, GradientDescentBase)]
+        self.gd_map = {}
+        for u in wf.units:
+            if isinstance(u, GradientDescentBase) and \
+                    u.target is not None:
+                self.gd_map[u.target] = u
+        # Batch vectors: declared by the loader via
+        # ``step_batch_vectors`` (duck-typed).
+        self.batch_vectors = []
+        self.const_vectors = []
+        for u in wf.units:
+            get_bv = getattr(u, "step_batch_vectors", None)
+            if get_bv is not None:
+                self.batch_vectors.extend(get_bv())
+            get_cv = getattr(u, "step_const_vectors", None)
+            if get_cv is not None:
+                self.const_vectors.extend(get_cv())
+        self.persist_vectors = []
+        for u in self.forward_units:
+            get_pv = getattr(u, "step_persist_vectors", None)
+            if get_pv is not None:
+                self.persist_vectors.extend(get_pv())
+
+    def param_name(self, unit, attr):
+        return "%s/%s" % (unit.name, attr)
+
+    def _collect(self, which):
+        out = {}
+        for u in self.forward_units:
+            mapping = u.trainables if which == "params" else u.tstate
+            for attr, vec in mapping.items():
+                out[self.param_name(u, attr)] = vec
+            if which == "state":
+                gd = self.gd_map.get(u)
+                if gd is not None:
+                    for attr, vec in gd.tstate.items():
+                        out[self.param_name(gd, attr)] = vec
+        return out
+
+    # -- compilation -------------------------------------------------------
+
+    def fingerprint(self):
+        """Shapes/dtypes of all step tensors — recompile trigger."""
+        parts = []
+        for vec in (list(self._collect("params").values()) +
+                    list(self._collect("state").values()) +
+                    self.batch_vectors):
+            parts.append((vec.shape, str(vec.dtype)))
+        return tuple(parts)
+
+    def compile(self):
+        import jax
+
+        enable_compilation_cache()
+        self.analyze()
+        param_vecs = self._collect("params")
+        state_vecs = self._collect("state")
+        forward_units = list(self.forward_units)
+        gd_map = dict(self.gd_map)
+        batch_ids = [str(id(v)) for v in self.batch_vectors]
+        batch_vecs = list(self.batch_vectors)
+        const_ids = [str(id(v)) for v in self.const_vectors]
+        const_vecs = list(self.const_vectors)
+        persist_ids = [str(id(v)) for v in self.persist_vectors]
+        pname = self.param_name
+
+        def run_forward(params, states, batch, consts, key, training):
+            bag = {}
+            for bid, vec in zip(batch_ids, batch_vecs):
+                bag[id(vec)] = batch[bid]
+            for cid, vec in zip(const_ids, const_vecs):
+                bag[id(vec)] = consts[cid]
+            ctx = StepContext(key=key, training=training)
+
+            def read(vec):
+                try:
+                    return bag[id(vec)]
+                except KeyError:
+                    raise KeyError(
+                        "traced read of vector %r not yet produced — "
+                        "check control links imply data order" % vec)
+
+            def write(vec, val):
+                bag[id(vec)] = val
+
+            new_states = dict(states)
+            for u in forward_units:
+                uparams = {a: params[pname(u, a)]
+                           for a in u.trainables}
+                ustate = {a: states[pname(u, a)] for a in u.tstate}
+                # Units may update their own non-trainable state
+                # (e.g. epoch accumulators, batch-norm stats) by
+                # returning a dict from tforward.
+                upd = u.tforward(read, write, uparams, ctx,
+                                 state=ustate or None) or {}
+                for a, val in upd.items():
+                    new_states[pname(u, a)] = val
+            outputs = {pid: bag[int(pid)] for pid in persist_ids
+                       if int(pid) in bag}
+            metrics = dict(ctx.metrics)
+            if ctx.loss is not None:
+                metrics["loss"] = ctx.loss
+            return ctx.loss, metrics, new_states, outputs
+
+        def apply_updates(params, grads, new_states, gate):
+            """Runs every GD unit's update rule; ``gate`` (None or a
+            0/1 tracer) masks updates out for padded/validation ticks
+            in block mode."""
+            import jax.numpy as jnp
+            new_params = dict(params)
+            for u in forward_units:
+                if not u.trainables:
+                    continue
+                gd = gd_map.get(u)
+                if gd is None:
+                    continue
+                for attr in u.trainables:
+                    key_ = pname(u, attr)
+                    gstate = {a: new_states[pname(gd, a)]
+                              for a in gd.tstate}
+                    new_p, new_gs = gd.tupdate(
+                        attr, params[key_], grads[key_], gstate, None)
+                    if gate is not None:
+                        new_p = jnp.where(gate, new_p, params[key_])
+                    new_params[key_] = new_p
+                    for a, val in new_gs.items():
+                        if gate is not None:
+                            val = jnp.where(
+                                gate, val, new_states[pname(gd, a)])
+                        new_states[pname(gd, a)] = val
+            return new_params, new_states
+
+        def train_step(params, states, batch, consts, key):
+            def loss_fn(p):
+                loss, metrics, new_states, outputs = run_forward(
+                    p, states, batch, consts, key, True)
+                if loss is None:
+                    raise ValueError(
+                        "no unit called ctx.set_loss() — an evaluator "
+                        "must be present in the traced chain")
+                return loss, (metrics, new_states, outputs)
+            grads, (metrics, new_states, outputs) = jax.grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_states = apply_updates(
+                params, grads, new_states, None)
+            return new_params, new_states, outputs, metrics
+
+        def infer_step(params, states, batch, consts, key):
+            _, metrics, new_states, outputs = run_forward(
+                params, states, batch, consts, key, False)
+            return new_states, outputs, metrics
+
+        def block_step(params, states, blocks, consts, key, training):
+            """K minibatch ticks in ONE dispatch: lax.scan over the
+            stacked per-tick inputs.  ``training`` is a traced 0/1
+            scalar, so train and validation blocks share one compiled
+            program; updates are gated by training AND per-tick
+            validity (padded ticks have all-zero masks).  This is the
+            latency-robust path: host→device traffic is one stacked
+            upload per K ticks and there is NO per-tick host sync —
+            epoch metrics accumulate on-device (EvaluatorBase)."""
+            import jax.numpy as jnp
+            from jax import lax
+            K = next(iter(blocks.values())).shape[0]
+            tick_ids = jnp.arange(K)
+
+            def body(carry, xs):
+                p, s = carry
+                batch_t, t = xs
+                tick_key = jax.random.fold_in(key, t)
+
+                def loss_fn(pp):
+                    loss, metrics, new_s, _ = run_forward(
+                        pp, s, batch_t, consts, tick_key, training)
+                    return loss, (metrics, new_s)
+                grads, (metrics, new_s) = jax.grad(
+                    loss_fn, has_aux=True)(p)
+                valid = metrics.get("n_valid", jnp.float32(1.0)) > 0
+                gate = jnp.logical_and(training > 0, valid)
+                new_p, new_s = apply_updates(p, grads, new_s, gate)
+                return (new_p, new_s), None
+
+            (params, states), _ = lax.scan(
+                body, (params, states), (blocks, tick_ids))
+            return params, states
+
+        self._train = jax.jit(train_step, donate_argnums=(0, 1))
+        self._infer = jax.jit(infer_step, donate_argnums=(1,))
+        self._block = jax.jit(block_step, donate_argnums=(0, 1))
+        # Raw (un-jitted) callables for AOT export / compile checks.
+        self._train_fn = train_step
+        self._infer_fn = infer_step
+        self._block_fn = block_step
+        self._param_vecs = param_vecs
+        self._state_vecs = state_vecs
+        self._fingerprint = self.fingerprint()
+        self._compiled = True
+
+    # -- execution ---------------------------------------------------------
+
+    def execute(self, key=None, training=True):
+        if not self._compiled or self.fingerprint() != self._fingerprint:
+            self.compile()
+        params = {n: v.devmem for n, v in self._param_vecs.items()}
+        states = {n: v.devmem for n, v in self._state_vecs.items()}
+        batch = {str(id(v)): v.devmem for v in self.batch_vectors}
+        consts = {str(id(v)): v.devmem for v in self.const_vectors}
+        if key is None:
+            from . import prng
+            key = prng.get().jax_key()
+        if training:
+            new_params, new_states, outputs, metrics = self._train(
+                params, states, batch, consts, key)
+            for n, v in self._param_vecs.items():
+                v.devmem = new_params[n]
+        else:
+            new_states, outputs, metrics = self._infer(
+                params, states, batch, consts, key)
+        for n, v in self._state_vecs.items():
+            v.devmem = new_states[n]
+        for vec in self.persist_vectors:
+            pid = str(id(vec))
+            if pid in outputs:
+                vec.devmem = outputs[pid]
+        return metrics
+
+    def execute_block(self, blocks, training, key=None):
+        """Dispatches K stacked ticks at once; ``blocks`` maps batch
+        vector id → (K, ...) numpy/jax array."""
+        import jax.numpy as jnp
+        if not self._compiled or self.fingerprint() != self._fingerprint:
+            self.compile()
+        params = {n: v.devmem for n, v in self._param_vecs.items()}
+        states = {n: v.devmem for n, v in self._state_vecs.items()}
+        consts = {str(id(v)): v.devmem for v in self.const_vectors}
+        if key is None:
+            from . import prng
+            key = prng.get().jax_key()
+        new_params, new_states = self._block(
+            params, states, blocks, consts, key,
+            jnp.float32(1.0 if training else 0.0))
+        for n, v in self._param_vecs.items():
+            v.devmem = new_params[n]
+        for n, v in self._state_vecs.items():
+            v.devmem = new_states[n]
+        return {}
+
+
+class AcceleratedWorkflow(Workflow):
+    """Workflow whose traced inner loop runs as one jitted step
+    (reference: accelerated_units.py:820 ``AcceleratedWorkflow``)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(AcceleratedWorkflow, self).__init__(workflow, **kwargs)
+        self.fused = kwargs.get("fused", True)
+        # >1 enables block mode: lax.scan over this many minibatches
+        # per dispatch (latency-robust; one XLA computation per block).
+        self.ticks_per_dispatch = kwargs.get("ticks_per_dispatch", 1)
+        self.step_metrics = {}
+
+    def init_unpickled(self):
+        super(AcceleratedWorkflow, self).init_unpickled()
+        self._compiler_ = None
+        self._tick_id_ = 0
+        self._step_done_tick_ = -1
+
+    @property
+    def compiler(self):
+        if self._compiler_ is None:
+            self._compiler_ = StepCompiler(self)
+        return self._compiler_
+
+    def begin_tick(self):
+        """Called by the loader at the start of every minibatch tick."""
+        self._tick_id_ += 1
+
+    @property
+    def training(self):
+        """Whether the current tick is a training minibatch; loaders
+        override the source of truth via link."""
+        for u in self.units:
+            is_train = getattr(u, "minibatch_is_training", None)
+            if is_train is not None:
+                return bool(is_train)
+        return True
+
+    def execute_step(self, trigger):
+        """Runs the fused step exactly once per tick, whichever traced
+        unit's gate fires first."""
+        if self._step_done_tick_ == self._tick_id_:
+            return
+        self._step_done_tick_ = self._tick_id_
+        from . import prng
+        metrics = self.compiler.execute(
+            key=prng.get().jax_key(), training=self.training)
+        self.step_metrics = metrics
+
+    def execute_block(self, blocks, training=None):
+        """Dispatches a stacked block of ticks (see
+        StepCompiler.execute_block)."""
+        if self._step_done_tick_ == self._tick_id_:
+            return
+        self._step_done_tick_ = self._tick_id_
+        from . import prng
+        if training is None:
+            training = self.training
+        self.compiler.execute_block(
+            blocks, training, key=prng.get().jax_key())
+        self.step_metrics = {}
+
+    def fetch_metrics(self):
+        """Host values of the last step metrics (small transfers)."""
+        import jax
+        return {k: jax.device_get(v)
+                for k, v in self.step_metrics.items()}

@@ -1,0 +1,3 @@
+from .base import (CLASS_NAME, TEST, VALID, TRAIN, Loader, ILoader,
+                   UserLoaderRegistry)  # noqa: F401
+from .fullbatch import FullBatchLoader  # noqa: F401

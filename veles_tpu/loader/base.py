@@ -1,0 +1,308 @@
+"""Minibatch-serving loader base.
+
+Capability parity with the reference loader (reference:
+veles/loader/base.py — ``Loader:120``, ``ILoader:100``, sample classes
+``:72-80``, ``serve_next_minibatch:724``, coordinator/worker index split
+``:626-685``, ``analyze_dataset:753``, ``shuffle:709``, epoch/flag logic
+``:856-907``):
+
+  * three sample classes — TEST(0), VALIDATION(1), TRAIN(2) — walked in
+    class order within each epoch;
+  * a shuffled train index space (validation/test stay ordered);
+  * epoch accounting: ``last_minibatch``, ``epoch_ended``,
+    ``epoch_number``;
+  * a failed-minibatch retry queue — indices whose processing was lost
+    (worker death) are re-served before fresh ones
+    (reference base.py:194,216-232,677-685);
+  * in distributed mode the coordinator serves only **indices** and the
+    workers materialize data locally (base.py:629-661) — here the same
+    index-space thinking becomes per-device sharding: a global batch of
+    indices is laid out along the mesh's data axis (see
+    loader/fullbatch.py for the device-side gather).
+
+TPU-era constraint: jitted steps need static shapes, so the final
+partial minibatch of a class is PADDED to ``max_minibatch_size`` and a
+``minibatch_mask`` marks valid rows (evaluators apply the mask); the
+reference instead shrank ``minibatch_size`` per tick.
+"""
+
+import numpy
+
+from .. import prng
+from ..error import BadFormatError
+from ..memory import Vector
+from ..registry import MappedUnitRegistry
+from ..units import Unit
+
+#: Sample-class ids (reference: loader/base.py:72-80).
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAME = ("test", "validation", "train")
+
+
+class UserLoaderRegistry(MappedUnitRegistry):
+    """String → loader class factory (reference: base.py:83-93)."""
+    registry = {}
+
+
+class ILoader(object):
+    """The loader contract (reference: base.py:100)."""
+
+    def load_data(self):
+        """Populates class_lengths (and dataset payloads)."""
+        raise NotImplementedError()
+
+    def create_minibatch_data(self):
+        """Allocates minibatch output vectors."""
+        raise NotImplementedError()
+
+    def fill_minibatch(self):
+        """Materializes the current minibatch from indices."""
+        raise NotImplementedError()
+
+
+class Loader(Unit, metaclass=UserLoaderRegistry):
+    """Serves minibatches tick by tick (reference: base.py:120)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        self.max_minibatch_size = kwargs.get("minibatch_size", 100)
+        self.class_lengths = [0, 0, 0]
+        self.epoch_number = 0
+        self.prng_key = kwargs.get("prng_key", 0)
+        self.shuffle_limit = kwargs.get("shuffle_limit", numpy.inf)
+        self.train_ratio = kwargs.get("train_ratio", 1.0)
+        super(Loader, self).__init__(workflow, **kwargs)
+        self.view_group = "LOADER"
+        # Per-tick outputs (host scalars + device vectors).
+        self.minibatch_class = TRAIN
+        self.minibatch_size = 0
+        self.last_minibatch = False
+        self.epoch_ended = False
+        self.minibatch_indices = Vector()
+        self.minibatch_mask = Vector()
+        # Device-side copy of minibatch_class so evaluators can route
+        # on-device epoch accumulation without a host sync.
+        self.minibatch_class_vec = Vector()
+        # Epoch state.
+        self.global_offset = 0
+        self.shuffled_indices = Vector()
+        self.failed_minibatches = []
+        self._pending_indices_ = {}
+
+    def init_unpickled(self):
+        super(Loader, self).init_unpickled()
+        self._pending_indices_ = {}
+
+    # -- derived sizes -----------------------------------------------------
+
+    @property
+    def total_samples(self):
+        return sum(self.class_lengths)
+
+    @property
+    def class_end_offsets(self):
+        ends, acc = [], 0
+        for ln in self.class_lengths:
+            acc += ln
+            ends.append(acc)
+        return ends
+
+    @property
+    def minibatch_is_training(self):
+        return self.minibatch_class == TRAIN
+
+    def class_of_offset(self, offset):
+        for cls, end in enumerate(self.class_end_offsets):
+            if offset < end:
+                return cls
+        raise BadFormatError("offset %d beyond dataset" % offset)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def initialize(self, **kwargs):
+        super(Loader, self).initialize(**kwargs)
+        self.load_data()
+        if self.total_samples == 0:
+            raise BadFormatError("loader has no samples after load_data")
+        if self.class_lengths[TRAIN] > 0 and self.train_ratio < 1.0:
+            self.class_lengths[TRAIN] = max(
+                1, int(self.class_lengths[TRAIN] * self.train_ratio))
+        self.shuffled_indices.mem = numpy.arange(
+            self.total_samples, dtype=numpy.int32)
+        self.minibatch_indices.mem = numpy.zeros(
+            self.max_minibatch_size, dtype=numpy.int32)
+        self.minibatch_mask.mem = numpy.zeros(
+            self.max_minibatch_size, dtype=numpy.float32)
+        self.minibatch_class_vec.mem = numpy.zeros(
+            (), dtype=numpy.int32)
+        self.create_minibatch_data()
+        self.shuffle()
+
+    def shuffle(self):
+        """Shuffles ONLY the train tail of the index space
+        (reference: base.py:709)."""
+        if self.epoch_number >= self.shuffle_limit:
+            return
+        if self.class_lengths[TRAIN] == 0:
+            return
+        train_start = self.class_end_offsets[VALID]
+        arr = self.shuffled_indices.mem
+        prng.get(self.prng_key).shuffle(arr[train_start:])
+        self.shuffled_indices.mem = arr
+
+    # -- the tick ----------------------------------------------------------
+
+    def run(self):
+        self.serve_next_minibatch()
+        self.fill_minibatch()
+
+    def serve_next_minibatch(self, slave_id=None):
+        """Advances the global offset and publishes the next minibatch's
+        indices + flags (reference: base.py:724)."""
+        if self.failed_minibatches:
+            # Re-serve lost work first (reference: base.py:677-685);
+            # entries carry their sample class so retries don't
+            # inherit whatever class was served last.
+            indices, cls = self.failed_minibatches.pop()
+            self.minibatch_class = cls
+            self.last_minibatch = False
+            self.epoch_ended = False
+        else:
+            indices = self._next_fresh_indices()
+        if slave_id is not None:
+            self._pending_indices_[slave_id] = (
+                indices, self.minibatch_class)
+        count = len(indices)
+        mask = numpy.zeros(self.max_minibatch_size, dtype=numpy.float32)
+        mask[:count] = 1.0
+        padded = numpy.zeros(self.max_minibatch_size, dtype=numpy.int32)
+        padded[:count] = indices
+        self.minibatch_indices.mem = padded
+        self.minibatch_mask.mem = mask
+        self.minibatch_class_vec.mem = numpy.array(
+            self.minibatch_class, dtype=numpy.int32)
+        self.minibatch_size = count
+        return indices
+
+    def _next_fresh_indices(self):
+        ends = self.class_end_offsets
+        if self.global_offset >= self.total_samples:
+            self.global_offset = 0
+        # (class_of_offset never yields an empty class: an empty
+        # class's end equals its start, and the strict < scan passes
+        # it by.)
+        cls = self.class_of_offset(self.global_offset)
+        self.minibatch_class = cls
+        cls_end = ends[cls]
+        start = self.global_offset
+        stop = min(start + self.max_minibatch_size, cls_end)
+        self.global_offset = stop
+        indices = numpy.array(
+            self.shuffled_indices.mem[start:stop], dtype=numpy.int32)
+        self._update_flags(stop)
+        return indices
+
+    def _update_flags(self, stop):
+        """Epoch/flag logic (reference: base.py:856-907)."""
+        ends = self.class_end_offsets
+        self.last_minibatch = stop in ends and stop != 0
+        self.epoch_ended = (stop == self.total_samples)
+        if self.epoch_ended:
+            self.epoch_number += 1
+            self.global_offset = 0
+            self.shuffle()
+
+    def serve_block(self, max_ticks):
+        """Serves up to ``max_ticks`` consecutive minibatches of the
+        SAME sample class (stopping at class boundaries so epoch flags
+        stay truthful), padded to exactly ``max_ticks`` with all-zero
+        masks.  Returns {vector_id: (max_ticks, ...) array} for the
+        block executor."""
+        idxs, masks = [], []
+        cls = None
+        for _ in range(max_ticks):
+            if self.failed_minibatches:
+                # Failed-batch retries are served singly (they may
+                # belong to a different class than the current walk).
+                if idxs:
+                    break
+            next_off = self.global_offset \
+                if self.global_offset < self.total_samples else 0
+            next_cls = self.class_of_offset(next_off)
+            if cls is not None and next_cls != cls:
+                break
+            self.serve_next_minibatch()
+            cls = self.minibatch_class
+            idxs.append(self.minibatch_indices.mem.copy())
+            masks.append(self.minibatch_mask.mem.copy())
+            if self.last_minibatch or self.failed_minibatches:
+                break
+        served = len(idxs)
+        pad = max_ticks - served
+        if pad:
+            zero_i = numpy.zeros_like(idxs[0])
+            zero_m = numpy.zeros_like(masks[0])
+            idxs.extend([zero_i] * pad)
+            masks.extend([zero_m] * pad)
+        cls_arr = numpy.full(max_ticks, self.minibatch_class,
+                             dtype=numpy.int32)
+        return {
+            str(id(self.minibatch_indices)): numpy.stack(idxs),
+            str(id(self.minibatch_mask)): numpy.stack(masks),
+            str(id(self.minibatch_class_vec)): cls_arr,
+        }
+
+    # -- distributed contract ----------------------------------------------
+
+    def generate_data_for_slave(self, slave=None):
+        """The coordinator ships only indices (reference:
+        base.py:629-661)."""
+        indices = self.serve_next_minibatch(slave_id=slave)
+        return {"indices": indices,
+                "minibatch_class": self.minibatch_class,
+                "epoch_number": self.epoch_number}
+
+    def apply_data_from_master(self, data):
+        indices = numpy.asarray(data["indices"], dtype=numpy.int32)
+        count = len(indices)
+        padded = numpy.zeros(self.max_minibatch_size, dtype=numpy.int32)
+        padded[:count] = indices
+        mask = numpy.zeros(self.max_minibatch_size, dtype=numpy.float32)
+        mask[:count] = 1.0
+        self.minibatch_indices.mem = padded
+        self.minibatch_mask.mem = mask
+        self.minibatch_size = count
+        self.minibatch_class = data["minibatch_class"]
+        self.epoch_number = data["epoch_number"]
+
+    def apply_data_from_slave(self, data, slave=None):
+        self._pending_indices_.pop(slave, None)
+
+    def drop_slave(self, slave=None):
+        """Requeues the dropped worker's in-flight minibatch with its
+        class (reference: base.py:677-685)."""
+        pending = self._pending_indices_.pop(slave, None)
+        if pending is not None:
+            self.failed_minibatches.append(pending)
+
+    # -- pickling: pending work is requeued so nothing is lost -------------
+
+    def __getstate__(self):
+        state = super(Loader, self).__getstate__()
+        pending = list(self._pending_indices_.values())
+        state["failed_minibatches"] = (
+            list(self.failed_minibatches) + pending)
+        return state
+
+    # -- subclass hooks ----------------------------------------------------
+
+    def load_data(self):
+        raise NotImplementedError()
+
+    def create_minibatch_data(self):
+        raise NotImplementedError()
+
+    def fill_minibatch(self):
+        """Host-side materialization hook; device-resident loaders do
+        the gather inside the fused step instead."""

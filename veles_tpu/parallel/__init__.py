@@ -1,0 +1,1 @@
+from .mesh import make_mesh, apply_dp_sharding  # noqa: F401

@@ -1,0 +1,80 @@
+"""Mesh construction and data-parallel sharding.
+
+This replaces the reference's master–slave data-parallel engine
+(reference: veles/server.py, veles/client.py, veles/distributable.py —
+minibatch indices sharded to slaves over ZeroMQ, weights shipped in job
+pickles, gradients aggregated in ``apply_data_from_slave``) with the
+TPU-native formulation:
+
+  * the device mesh (`jax.sharding.Mesh`) spans all local chips (and,
+    multi-host, all processes' chips via ``jax.distributed``);
+  * the LOADER still thinks in minibatch indices — exactly like the
+    reference coordinator (loader/base.py:629-661) — but instead of
+    mailing index lists to worker processes, the index array is laid
+    out along the mesh's ``data`` axis, so each chip gathers and
+    processes its shard of the global minibatch;
+  * parameters are replicated; ``jax.grad`` of the mean loss over a
+    sharded batch makes XLA insert the gradient all-reduce (psum) over
+    ICI — the explicit ``apply_data_from_slave`` aggregation loop
+    disappears into the compiled step.
+
+Elasticity note: the reference drops slaves and requeues their
+minibatches (server.py:315-338).  SPMD equivalents operate at mesh
+granularity: on chip loss the launcher rebuilds the mesh and the loader
+requeues in-flight indices (the failed-minibatch queue survives as-is).
+"""
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def make_mesh(devices=None, axes=None):
+    """Builds a Mesh; ``axes`` maps name → size with -1 = remaining."""
+    import jax
+    import numpy as np
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"data": len(devices)}
+    names = list(axes)
+    sizes = [axes[n] for n in names]
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = len(devices) // known
+    count = 1
+    for s in sizes:
+        count *= s
+    return Mesh(np.array(devices[:count]).reshape(sizes), names)
+
+
+def apply_dp_sharding(workflow, mesh, axis="data"):
+    """Marks the workflow's step tensors for data parallelism:
+    per-tick batch vectors are sharded along ``axis`` (dim 0), params /
+    optimizer state / dataset originals are replicated.
+
+    After this, the SAME compiled step runs 1-chip or N-chip — XLA
+    inserts the gradient psum over ICI because the loss is a mean over
+    a sharded batch with replicated params.
+    """
+    compiler = workflow.compiler
+    compiler.analyze()
+    replicated = NamedSharding(mesh, PartitionSpec())
+    sharded = NamedSharding(mesh, PartitionSpec(axis))
+    n = mesh.shape[axis]
+    for vec in compiler.batch_vectors:
+        shape = vec.shape
+        if shape and len(shape) >= 1 and shape[0] % n == 0:
+            vec.sharding = sharded
+        else:
+            vec.sharding = replicated
+    for vec in compiler._collect("params").values():
+        vec.sharding = replicated
+    for vec in compiler._collect("state").values():
+        vec.sharding = replicated
+    for vec in compiler.const_vectors:
+        vec.sharding = replicated
+    # Activations derive shardings from inputs; persisted outputs too.
+    workflow.mesh = mesh
+    return workflow

@@ -33,25 +33,37 @@ class MappedObjectRegistry(type):
     """Metaclass for string→class factories
     (reference: veles/mapped_object_registry.py:36).
 
-    Subclass hierarchies set ``MAPPING = "some-name"`` on concrete
-    classes and a class-level ``registry`` dict on the base; lookups go
-    through ``base.registry["some-name"]``.
+    Concrete metaclass subclasses declare ``registry = {}`` on
+    THEMSELVES; classes built with them set ``MAPPING = "some-name"``
+    and become reachable via ``TheMetaclass.registry["some-name"]``
+    (and :meth:`find`).
     """
+
+    registry = None
 
     def __init__(cls, name, bases, clsdict):
         super(MappedObjectRegistry, cls).__init__(name, bases, clsdict)
         mapping = clsdict.get("MAPPING")
-        if mapping is None:
-            return
-        # Find the registry dict on the nearest base that defines one.
-        for klass in cls.__mro__:
-            registry = klass.__dict__.get("registry")
-            if registry is not None:
-                break
-        else:
+        registry = type(cls).registry
+        if mapping is None or registry is None:
             return
         if mapping in registry and registry[mapping] is not cls:
             raise AlreadyExistsError(
                 "MAPPING %r is already taken by %s" %
                 (mapping, registry[mapping]))
         registry[mapping] = cls
+
+    @classmethod
+    def get_factory(mcs, mapping):
+        if mcs.registry is None or mapping not in mcs.registry:
+            raise NotExistsError(
+                "no %s registered as %r (have: %s)" %
+                (mcs.__name__, mapping,
+                 sorted(mcs.registry or ())))
+        return mcs.registry[mapping]
+
+
+class MappedUnitRegistry(UnitRegistry, MappedObjectRegistry):
+    """Combined metaclass for Unit hierarchies that are also
+    string-mapped factories (reference: unit_registry.py:178)."""
+    registry = None

@@ -89,10 +89,17 @@ class Workflow(Container):
         return self._topological_order()
 
     def add_ref(self, unit):
-        """Registers a unit (reference: workflow.py ``add_ref``)."""
+        """Registers a unit; names are made unique (step-state keys and
+        ``wf[name]`` lookups depend on it)."""
         if unit is self:
             raise Bug("a workflow cannot contain itself")
         if unit not in self._units:
+            taken = {u.name for u in self._units}
+            if unit.name in taken:
+                i = 1
+                while "%s_%d" % (unit.name, i) in taken:
+                    i += 1
+                unit.name = "%s_%d" % (unit.name, i)
             self._units.append(unit)
         unit.workflow = self
 

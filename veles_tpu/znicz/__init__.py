@@ -1,0 +1,20 @@
+"""znicz — the neural-network layer library.
+
+The reference's znicz is an empty git submodule (reference:
+.gitmodules:1-5, veles/znicz/ contains no files); its capability surface
+is reconstructed from BASELINE.json configs (All2All, Conv, Pooling,
+GradientDescent units, evaluators, decision, RBM pretraining) and the
+core hooks that remain in the reference repo (kernels in ocl/ + cuda/,
+veles/accelerated_units.py).  Everything here is a TracedUnit whose
+forward composes into the workflow's single jitted step; backward comes
+from jax.grad, and per-layer GradientDescent units apply their own
+update rules inside the same jit.
+"""
+
+from .nn_units import ForwardBase, GradientDescentBase  # noqa: F401
+from .all2all import (All2All, All2AllTanh, All2AllRelu,  # noqa: F401
+                      All2AllSigmoid, All2AllSoftmax)
+from .evaluator import EvaluatorSoftmax, EvaluatorMSE  # noqa: F401
+from .gd import (GradientDescent, GDTanh, GDRelu,  # noqa: F401
+                 GDSigmoid, GDSoftmax)
+from .decision import DecisionBase, DecisionGD  # noqa: F401

@@ -1,0 +1,148 @@
+"""Fully-connected (All2All) layer units.
+
+Reconstructed from the znicz capability surface (BASELINE.json: "All2All
++ GD" MNIST784 workflow; GEMM kernels ocl/gemm.cl,
+ocl/matrix_multiplication.cl survive in the reference core): an All2All
+layer is output = activation(input·W + b).
+
+TPU-era mapping: the GEMM is a single ``jnp.dot`` that XLA places on
+the MXU; activation fuses into the same kernel; inputs flatten
+per-sample automatically (the reference reshaped on device).  Compute
+runs in the configured precision policy (bf16 matmuls by default,
+f32 accumulation via ``preferred_element_type``).
+"""
+
+import numpy
+
+from .nn_units import ForwardBase
+
+
+class All2All(ForwardBase):
+    """Linear layer (identity activation)."""
+
+    MAPPING = "all2all"
+    A = 1.0  # activation output scale (znicz ergonomics)
+
+    def __init__(self, workflow, **kwargs):
+        super(All2All, self).__init__(workflow, **kwargs)
+        self.output_sample_shape = kwargs.get("output_sample_shape",
+                                              kwargs.get("output_shape"))
+        if isinstance(self.output_sample_shape, int):
+            self.output_sample_shape = (self.output_sample_shape,)
+        if self.output_sample_shape is None:
+            raise ValueError("%s requires output_sample_shape" % self)
+
+    @property
+    def neurons_number(self):
+        n = 1
+        for d in self.output_sample_shape:
+            n *= d
+        return n
+
+    def initialize(self, device=None, **kwargs):
+        super(All2All, self).initialize(device=device, **kwargs)
+        batch = self.input.shape[0]
+        fan_in = self.input.size // batch
+        n_out = self.neurons_number
+        if not self.weights:
+            stddev = self.weights_stddev or (1.0 / numpy.sqrt(fan_in))
+            w = numpy.zeros((fan_in, n_out), dtype=numpy.float32)
+            self.rand().fill_normal(w, stddev=stddev)
+            self.weights.mem = w
+            self.weights.initialize(self.device)
+        if self.include_bias and not self.bias:
+            b = numpy.zeros(n_out, dtype=numpy.float32)
+            if self.bias_stddev:
+                self.rand().fill_normal(b, stddev=self.bias_stddev)
+            self.bias.mem = b
+            self.bias.initialize(self.device)
+        out_shape = (batch,) + tuple(self.output_sample_shape)
+        self.output.mem = numpy.zeros(out_shape, dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def activation(self, v):
+        return v
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        x = read(self.input)
+        x = x.reshape(x.shape[0], -1)
+        w = params["weights"]
+        cdt = self.compute_dtype
+        # bf16 inputs on the MXU with f32 accumulation.
+        y = jnp.dot(x.astype(cdt), w.astype(cdt),
+                    preferred_element_type=jnp.float32)
+        if self.include_bias:
+            y = y + params["bias"]
+        y = self.activation(y)
+        batch = x.shape[0]
+        write(self.output,
+              y.reshape((batch,) + tuple(self.output_sample_shape)))
+
+
+class All2AllTanh(All2All):
+    """Scaled tanh activation (znicz used 1.7159·tanh(0.6666·x))."""
+
+    MAPPING = "all2all_tanh"
+    A = 1.7159
+    B = 0.6666
+
+    def activation(self, v):
+        import jax.numpy as jnp
+        return self.A * jnp.tanh(self.B * v)
+
+
+class All2AllRelu(All2All):
+    MAPPING = "all2all_relu"
+
+    def activation(self, v):
+        import jax.numpy as jnp
+        return jnp.maximum(v, 0)
+
+
+class All2AllSigmoid(All2All):
+    MAPPING = "all2all_sigmoid"
+
+    def activation(self, v):
+        import jax
+        return jax.nn.sigmoid(v)
+
+
+class All2AllSoftmax(All2All):
+    """Softmax output layer.
+
+    Writes BOTH ``output`` (probabilities, znicz-compatible) and
+    ``logits`` (pre-activation) — evaluators read the logits for a
+    numerically-stable cross-entropy (the reference computed CE from
+    probabilities; log-sum-exp over logits is the TPU-safe form).
+    """
+
+    MAPPING = "softmax"
+
+    def __init__(self, workflow, **kwargs):
+        super(All2AllSoftmax, self).__init__(workflow, **kwargs)
+        from ..memory import Vector
+        self.logits = Vector()
+        self.max_idx = Vector()
+
+    def initialize(self, device=None, **kwargs):
+        super(All2AllSoftmax, self).initialize(device=device, **kwargs)
+        batch = self.input.shape[0]
+        self.logits.mem = numpy.zeros(
+            (batch, self.neurons_number), dtype=numpy.float32)
+        self.logits.initialize(self.device)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax
+        import jax.numpy as jnp
+        x = read(self.input)
+        x = x.reshape(x.shape[0], -1)
+        w = params["weights"]
+        cdt = self.compute_dtype
+        logits = jnp.dot(x.astype(cdt), w.astype(cdt),
+                         preferred_element_type=jnp.float32)
+        if self.include_bias:
+            logits = logits + params["bias"]
+        write(self.logits, logits)
+        write(self.output, jax.nn.softmax(logits, axis=-1))
+        write(self.max_idx, jnp.argmax(logits, axis=-1))

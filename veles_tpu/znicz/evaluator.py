@@ -1,0 +1,131 @@
+"""Loss evaluator units.
+
+Reconstructed znicz capability surface (BASELINE.json: softmax/MSE
+evaluators).  The evaluator closes the forward chain: it computes the
+scalar loss (``ctx.set_loss`` → differentiated by the fused step) and
+the batch metrics (error count, loss) that the Decision unit consumes.
+
+The reference's evaluators emitted ``err_output`` to seed hand-written
+backprop; with autodiff that plumbing disappears — the loss IS the
+backward seed.  Partial (padded) minibatches are handled with the
+loader's mask (see loader/base.py docstring).
+"""
+
+import numpy
+
+from ..accelerated_units import TracedUnit
+from ..memory import Vector
+
+
+class EvaluatorBase(TracedUnit):
+    """Common evaluator machinery, including the ON-DEVICE epoch
+    accumulator: per-tick metrics are added into ``epoch_acc`` —
+    a (3 classes × 4) array of [err_sum, n_valid, loss_sum, n_ticks] —
+    inside the fused step, so the host only syncs at epoch boundaries
+    (one transfer per class-epoch instead of one per tick; essential
+    when the TPU is reached over a high-latency link)."""
+
+    hide_from_registry = True
+
+    ACC_ERR, ACC_VALID, ACC_LOSS, ACC_TICKS = range(4)
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorBase, self).__init__(workflow, **kwargs)
+        self.view_group = "EVALUATOR"
+        self.input = None        # linked: last layer's output/logits
+        self.mask = None         # linked: loader.minibatch_mask
+        self.minibatch_class_vec = None  # linked from loader
+        self.epoch_acc = Vector(numpy.zeros((3, 4),
+                                            dtype=numpy.float32))
+        self.demand("input")
+
+    @property
+    def tstate(self):
+        return {"epoch_acc": self.epoch_acc}
+
+    def _accumulate(self, read, state, err_sum, n_valid, loss):
+        import jax.numpy as jnp
+        if state is None:  # eager (per-unit) execution: no accumulator
+            return None
+        cls = read(self.minibatch_class_vec)
+        # Padded block ticks (all-zero mask) must not count: gate the
+        # whole row, including the tick counter, by validity.
+        valid = (n_valid > 0).astype(jnp.float32)
+        row = jnp.stack([err_sum, n_valid, loss * valid, valid])
+        return {"epoch_acc":
+                state["epoch_acc"].at[cls].add(row)}
+
+    def read_epoch_acc(self, cls):
+        """Host fetch of one class's accumulated row (epoch-boundary
+        sync point)."""
+        self.epoch_acc.map_read()
+        return numpy.array(self.epoch_acc.mem[cls])
+
+    def reset_epoch_acc(self, cls):
+        self.epoch_acc.map_write()
+        self.epoch_acc.mem[cls] = 0.0
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Masked softmax cross-entropy + error count.
+
+    Links: ``input`` ← softmax layer's ``logits``; ``labels`` ←
+    loader's ``minibatch_labels``; ``mask`` ← loader's
+    ``minibatch_mask``.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorSoftmax, self).__init__(workflow, **kwargs)
+        self.labels = None
+        self.demand("labels", "mask", "minibatch_class_vec")
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax
+        import jax.numpy as jnp
+        logits = read(self.input)
+        labels = read(self.labels)
+        mask = read(self.mask)
+        n_valid = jnp.maximum(mask.sum(), 1.0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, labels[:, None].astype(jnp.int32), axis=1)[:, 0]
+        loss = (nll * mask).sum() / n_valid
+        pred = jnp.argmax(logits, axis=-1)
+        n_err = ((pred != labels) * mask).sum()
+        ctx.set_loss(loss)
+        ctx.add_metric("n_err", n_err)
+        ctx.add_metric("n_valid", mask.sum())
+        return self._accumulate(read, state, n_err, mask.sum(), loss)
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Masked mean-squared-error against ``target``.
+
+    Links: ``input`` ← last layer output; ``target`` ← loader's
+    ``minibatch_targets`` (or data for autoencoders); ``mask``.
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorMSE, self).__init__(workflow, **kwargs)
+        self.target = None
+        self.root_metric = kwargs.get("root", True)
+        self.demand("target", "mask", "minibatch_class_vec")
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        y = read(self.input).astype(jnp.float32)
+        t = read(self.target).astype(jnp.float32)
+        mask = read(self.mask)
+        batch = y.shape[0]
+        n_valid = jnp.maximum(mask.sum(), 1.0)
+        se = ((y.reshape(batch, -1) - t.reshape(batch, -1)) ** 2
+              ).sum(axis=1)
+        loss = (se * mask).sum() / n_valid
+        ctx.set_loss(loss)
+        metric = jnp.sqrt(loss) if self.root_metric else loss
+        ctx.add_metric("mse", metric)
+        ctx.add_metric("n_valid", mask.sum())
+        # err_sum column carries the summed squared error so the
+        # decision can report per-epoch MSE.
+        return self._accumulate(read, state, (se * mask).sum(),
+                                mask.sum(), loss)

@@ -1,0 +1,39 @@
+"""Per-layer gradient-descent trainer units.
+
+Reconstructed znicz capability surface (BASELINE.json: "GradientDescent
+units" per layer type).  In the reference each layer type had a paired
+GD unit implementing its backward kernels AND the weight update; with
+autodiff the backward is derived, so all layer types share one update
+implementation (momentum SGD + L2, nn_units.GradientDescentBase) and
+the per-type classes remain for API/config parity — construct the GD
+unit matching your layer, link it with ``target=layer``.
+
+The distributed-aggregation hook (``apply_data_from_slave`` summing
+worker gradients, reference contract workflow.py:518-535) is replaced
+on-mesh by XLA's automatic gradient psum over the data axis — sharded
+batch + replicated params makes the ``jax.grad`` result a psum over
+ICI with no framework code (see parallel/).
+"""
+
+from .nn_units import GradientDescentBase
+
+
+class GradientDescent(GradientDescentBase):
+    """Trainer for plain All2All layers."""
+    MAPPING = "all2all"
+
+
+class GDTanh(GradientDescentBase):
+    MAPPING = "all2all_tanh"
+
+
+class GDRelu(GradientDescentBase):
+    MAPPING = "all2all_relu"
+
+
+class GDSigmoid(GradientDescentBase):
+    MAPPING = "all2all_sigmoid"
+
+
+class GDSoftmax(GradientDescentBase):
+    MAPPING = "softmax"

@@ -1,0 +1,161 @@
+"""Base classes for neural-network layer units.
+
+Reconstructed capability surface of the znicz submodule's nn_units
+(the submodule is empty in the reference checkout; hooks survive in
+veles/accelerated_units.py and the kernels in ocl/, cuda/):
+
+  * :class:`ForwardBase` — a forward layer with ``input``/``output``
+    Vectors and optional ``weights``/``bias`` trainables;
+  * :class:`GradientDescentBase` — the per-layer trainer unit holding
+    hyperparameters (learning rate, momentum, L2 decay) and momentum
+    state; in the reference each GD unit implemented the hand-written
+    backward kernels for its layer type, here the backward comes from
+    ``jax.grad`` over the composed forward and the GD unit only
+    applies its update rule inside the same jitted step.
+"""
+
+import numpy
+
+from .. import prng
+from ..accelerated_units import TracedUnit
+from ..config import root, get as config_get
+from ..memory import Vector
+from ..registry import MappedUnitRegistry
+
+
+class ForwardUnitRegistry(MappedUnitRegistry):
+    """String → forward-layer class (the reference's MappedUnitRegistry
+    role for znicz layers, unit_registry.py:178)."""
+    registry = {}
+
+
+class GDUnitRegistry(MappedUnitRegistry):
+    """String → trainer class; same MAPPING strings as the forward
+    registry, so ``gd_for(layer)`` pairs them."""
+    registry = {}
+
+
+def gd_for(layer_or_mapping):
+    """Returns the GD unit class paired with a forward layer (by its
+    MAPPING string)."""
+    mapping = getattr(layer_or_mapping, "MAPPING", layer_or_mapping)
+    return GDUnitRegistry.get_factory(mapping)
+
+
+class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
+    """A forward layer unit (znicz ``Forward`` analogue)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(ForwardBase, self).__init__(workflow, **kwargs)
+        self.view_group = "WORKER"
+        self.input = None            # linked Vector
+        self.output = Vector()
+        self.weights = Vector()
+        self.bias = Vector()
+        self.include_bias = kwargs.get("include_bias", True)
+        self.weights_stddev = kwargs.get("weights_stddev")
+        self.bias_stddev = kwargs.get("bias_stddev")
+        self.weights_transposed = kwargs.get("weights_transposed", False)
+        self.prng_key = kwargs.get("prng_key", 0)
+        self.demand("input")
+
+    @property
+    def trainables(self):
+        t = {}
+        if self.weights:
+            t["weights"] = self.weights
+        if self.include_bias and self.bias:
+            t["bias"] = self.bias
+        return t
+
+    @property
+    def compute_dtype(self):
+        """bf16 when precision_level==0, f32 otherwise (replaces the
+        reference's OpenCL precision defines, config.py:244-247)."""
+        level = config_get(root.common.engine.precision_level, 0)
+        import jax.numpy as jnp
+        return jnp.bfloat16 if level == 0 else jnp.float32
+
+    def rand(self):
+        return prng.get(self.prng_key)
+
+
+class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
+    """Per-layer trainer (znicz ``GradientDescentBase`` analogue).
+
+    Holds the update hyperparameters and momentum slots for its
+    ``target`` forward unit; ``tupdate`` is called inside the fused
+    step with the autodiff gradient.
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(GradientDescentBase, self).__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.target = kwargs.get("target")
+        self.learning_rate = kwargs.get("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.get(
+            "learning_rate_bias", self.learning_rate)
+        # L2 weight decay (the reference's "lambda"/weights_decay).
+        self.weights_decay = kwargs.get("weights_decay", 0.0)
+        self.weights_decay_bias = kwargs.get("weights_decay_bias", 0.0)
+        # Momentum (the reference's "gradient_moment").
+        self.gradient_moment = kwargs.get("gradient_moment", 0.0)
+        self.gradient_moment_bias = kwargs.get(
+            "gradient_moment_bias", self.gradient_moment)
+        self._velocities = {}
+
+    def link_target(self, target):
+        self.target = target
+        return self
+
+    @property
+    def tstate(self):
+        return dict(self._velocities)
+
+    def initialize(self, device=None, **kwargs):
+        super(GradientDescentBase, self).initialize(
+            device=device, **kwargs)
+        if self.target is None:
+            raise ValueError("%s has no target forward unit" % self)
+        if not self.target.is_initialized:
+            # Requeued by workflow.initialize until the target's
+            # weights exist (reference: workflow.py:307-331).
+            raise AttributeError(
+                "%s: target %s not initialized yet" %
+                (self.name, self.target.name))
+        if self.gradient_moment or self.gradient_moment_bias:
+            for attr, vec in self.target.trainables.items():
+                slot = "velocity_" + attr
+                if slot not in self._velocities:
+                    v = Vector(numpy.zeros(vec.shape, dtype=vec.dtype))
+                    v.initialize(self.device)
+                    self._velocities[slot] = v
+
+    def _hyper(self, attr):
+        if attr == "bias":
+            return (self.learning_rate_bias, self.weights_decay_bias,
+                    self.gradient_moment_bias)
+        return (self.learning_rate, self.weights_decay,
+                self.gradient_moment)
+
+    def tupdate(self, attr, param, grad, state, ctx):
+        """Classic momentum SGD with L2 decay (AlexNet-era rule used by
+        znicz GD units): v ← μv − lr·(g + λp); p ← p + v."""
+        lr, decay, moment = self._hyper(attr)
+        g = grad + decay * param if decay else grad
+        slot = "velocity_" + attr
+        new_state = {}
+        if moment and slot in state:
+            v = moment * state[slot] - lr * g
+            new_param = param + v
+            new_state[slot] = v
+        else:
+            new_param = param - lr * g
+        return new_param, new_state
+
+    def tforward(self, read, write, params, ctx, state=None):
+        """GD units contribute no forward compute."""

@@ -1,0 +1,1 @@
+"""Sample workflows — the parity configs from BASELINE.json."""
