@@ -1,0 +1,169 @@
+"""Conv / pooling / activation / dropout / LRN unit tests
+(reference analogue: znicz per-unit tests run through
+veles/tests/accelerated_test.py fixtures)."""
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.znicz.conv import Conv, Deconv
+from veles_tpu.znicz.pooling import (MaxPooling, MaxAbsPooling,
+                                     AvgPooling, StochasticPooling)
+from veles_tpu.znicz.lrn import LRNormalizerForward
+from veles_tpu.znicz.dropout import DropoutForward
+from veles_tpu.znicz.activation import ForwardTanhLog, ForwardSinCos
+from veles_tpu.memory import Vector
+
+
+def _unit_with_input(cls, data, **kwargs):
+    wf = DummyWorkflow()
+    unit = cls(wf, **kwargs)
+    unit.input = Vector(numpy.asarray(data, dtype=numpy.float32))
+    unit.initialize()
+    return unit
+
+
+def _np_conv_valid(x, w, stride=(1, 1), pad=((0, 0), (0, 0))):
+    """Reference NHWC/HWIO convolution in plain numpy."""
+    (pt, pb), (pl, pr) = pad
+    x = numpy.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+    b, h, ww, cin = x.shape
+    kh, kw, _, cout = w.shape
+    sh, sw = stride
+    oh = (h - kh) // sh + 1
+    ow = (ww - kw) // sw + 1
+    out = numpy.zeros((b, oh, ow, cout), dtype=numpy.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i * sh:i * sh + kh, j * sw:j * sw + kw, :]
+            out[:, i, j, :] = numpy.tensordot(
+                patch, w, axes=([1, 2, 3], [0, 1, 2]))
+    return out
+
+
+def test_conv_matches_numpy():
+    prng.get(0).seed(5)
+    rng = numpy.random.RandomState(3)
+    x = rng.rand(2, 8, 8, 3).astype(numpy.float32)
+    unit = _unit_with_input(Conv, x, n_kernels=4, kx=3, ky=3,
+                            padding=1, sliding=(2, 2))
+    unit.eager_run()
+    unit.weights.map_read()
+    unit.bias.map_read()
+    unit.output.map_read()
+    want = _np_conv_valid(x, unit.weights.mem, stride=(2, 2),
+                          pad=((1, 1), (1, 1))) + unit.bias.mem
+    assert unit.output.shape == (2, 4, 4, 4)
+    numpy.testing.assert_allclose(unit.output.mem, want, rtol=2e-2,
+                                  atol=2e-2)
+
+
+def test_conv_output_geometry():
+    prng.get(0).seed(5)
+    x = numpy.zeros((1, 32, 32, 3))
+    unit = _unit_with_input(Conv, x, n_kernels=7, kx=5, ky=5,
+                            padding=2)
+    assert unit.output.shape == (1, 32, 32, 7)
+
+
+def test_maxpooling():
+    x = numpy.arange(16, dtype=numpy.float32).reshape(1, 4, 4, 1)
+    unit = _unit_with_input(MaxPooling, x, kx=2, ky=2)
+    unit.eager_run()
+    unit.output.map_read()
+    want = numpy.array([[5, 7], [13, 15]], dtype=numpy.float32)
+    numpy.testing.assert_array_equal(unit.output.mem[0, :, :, 0], want)
+
+
+def test_maxabspooling_keeps_sign():
+    x = numpy.array([[1.0, -5.0], [2.0, 3.0]]).reshape(1, 2, 2, 1)
+    unit = _unit_with_input(MaxAbsPooling, x, kx=2, ky=2)
+    unit.eager_run()
+    unit.output.map_read()
+    assert unit.output.mem[0, 0, 0, 0] == -5.0
+
+
+def test_avgpooling_ragged_tail():
+    """Ceil-mode: a 5-wide input with 2×2 windows yields 3 columns,
+    the last averaging only the true population."""
+    x = numpy.ones((1, 5, 5, 1), dtype=numpy.float32)
+    unit = _unit_with_input(AvgPooling, x, kx=2, ky=2)
+    assert unit.output.shape == (1, 3, 3, 1)
+    unit.eager_run()
+    unit.output.map_read()
+    numpy.testing.assert_allclose(unit.output.mem, 1.0, rtol=1e-6)
+
+
+def test_stochastic_pooling_inference_weighted_mean():
+    x = numpy.array([[1.0, 3.0], [0.0, 0.0]]).reshape(1, 2, 2, 1)
+    unit = _unit_with_input(StochasticPooling, x, kx=2, ky=2)
+    unit.eager_run()  # eager = inference mode
+    unit.output.map_read()
+    # probs = [.25, .75, 0, 0] → weighted mean = .25·1 + .75·3 = 2.5
+    numpy.testing.assert_allclose(unit.output.mem[0, 0, 0, 0], 2.5,
+                                  rtol=1e-5)
+
+
+def test_lrn_formula():
+    x = numpy.ones((1, 2, 2, 5), dtype=numpy.float32)
+    unit = _unit_with_input(LRNormalizerForward, x)
+    unit.eager_run()
+    unit.output.map_read()
+    # Interior channel (full 5-window): denom = (2 + 1e-4/5·5)^.75.
+    want = 1.0 / (2.0 + 1e-4) ** 0.75
+    numpy.testing.assert_allclose(unit.output.mem[0, 0, 0, 2], want,
+                                  rtol=1e-5)
+
+
+def test_dropout_inference_identity():
+    x = numpy.random.RandomState(0).rand(4, 10).astype(numpy.float32)
+    unit = _unit_with_input(DropoutForward, x, dropout_ratio=0.5)
+    unit.eager_run()
+    unit.output.map_read()
+    numpy.testing.assert_allclose(unit.output.mem, x, rtol=1e-6)
+
+
+def test_activation_tanhlog_piecewise():
+    x = numpy.array([[0.5, 10.0]], dtype=numpy.float32)
+    unit = _unit_with_input(ForwardTanhLog, x)
+    unit.eager_run()
+    unit.output.map_read()
+    a, b, d = ForwardTanhLog.A, ForwardTanhLog.B, ForwardTanhLog.D
+    numpy.testing.assert_allclose(
+        unit.output.mem[0, 0], a * numpy.tanh(b * 0.5), rtol=1e-5)
+    numpy.testing.assert_allclose(
+        unit.output.mem[0, 1],
+        a * numpy.tanh(b * d) + numpy.log1p(10.0 - d), rtol=1e-5)
+
+
+def test_activation_sincos():
+    x = numpy.array([[0.3, 0.7, 1.1, 2.0]], dtype=numpy.float32)
+    unit = _unit_with_input(ForwardSinCos, x)
+    unit.eager_run()
+    unit.output.map_read()
+    want = numpy.array([numpy.sin(0.3), numpy.cos(0.7),
+                        numpy.sin(1.1), numpy.cos(2.0)])
+    numpy.testing.assert_allclose(unit.output.mem[0], want, rtol=1e-5)
+
+
+def test_deconv_inverts_geometry():
+    prng.get(0).seed(5)
+    rng = numpy.random.RandomState(3)
+    x = rng.rand(2, 8, 8, 3).astype(numpy.float32)
+    wf = DummyWorkflow()
+    conv = Conv(wf, n_kernels=4, kx=3, ky=3, padding=1,
+                sliding=(2, 2))
+    conv.input = Vector(x)
+    conv.initialize()
+    deconv = Deconv(wf, get_weights_from=conv)
+    deconv.input = conv.output
+    deconv.initialize()
+    assert deconv.output.shape == (2, 8, 8, 3)
+    # Execute: the traced result must actually HAVE the allocated
+    # geometry (transposed-conv output for stride-2 pad-1).
+    conv.eager_run()
+    deconv.eager_run()
+    deconv.output.map_read()
+    assert deconv.output.mem.shape == (2, 8, 8, 3)
+    assert numpy.abs(deconv.output.mem).max() > 0

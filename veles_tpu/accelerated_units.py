@@ -81,6 +81,18 @@ class StepContext(object):
         self.loss = value
 
 
+def select_by_training(ctx, train_fn, eval_fn):
+    """Train/eval branch select that works in BOTH step modes: with a
+    static Python bool (single-tick steps) it evaluates only the taken
+    branch; with a traced 0/1 ``training`` scalar (block mode, where
+    train and validation blocks share one compiled program) it
+    evaluates both and selects with ``jnp.where``."""
+    if isinstance(ctx.training, bool):
+        return train_fn() if ctx.training else eval_fn()
+    import jax.numpy as jnp
+    return jnp.where(ctx.training > 0, train_fn(), eval_fn())
+
+
 class AcceleratedUnit(Unit):
     """A unit owning device-resident Vectors (reference:
     accelerated_units.py:126).  ``initialize`` binds the device and
@@ -283,6 +295,12 @@ class StepCompiler(object):
                 bag[id(vec)] = batch[bid]
             for cid, vec in zip(const_ids, const_vecs):
                 bag[id(vec)] = consts[cid]
+            # Trainables are readable by OTHER units through the bag
+            # (tied-weight Deconv reads its conv's filters); gradient
+            # flows because these are the differentiated inputs.
+            for u in forward_units:
+                for a in u.trainables:
+                    bag[id(u.trainables[a])] = params[pname(u, a)]
             ctx = StepContext(key=key, training=training)
 
             def read(vec):

@@ -11,10 +11,31 @@ from jax.grad, and per-layer GradientDescent units apply their own
 update rules inside the same jit.
 """
 
-from .nn_units import ForwardBase, GradientDescentBase  # noqa: F401
+from .nn_units import (ForwardBase, GradientDescentBase,  # noqa: F401
+                       gd_for)
 from .all2all import (All2All, All2AllTanh, All2AllRelu,  # noqa: F401
-                      All2AllSigmoid, All2AllSoftmax)
+                      All2AllStrictRelu, All2AllSigmoid,
+                      All2AllSoftmax)
+from .conv import (Conv, ConvTanh, ConvRelu, ConvStrictRelu,  # noqa: F401
+                   ConvSigmoid, Deconv)
+from .pooling import (Pooling, MaxPooling, MaxAbsPooling,  # noqa: F401
+                      AvgPooling, StochasticPooling,
+                      StochasticAbsPooling)
+from .activation import (ActivationForward, ForwardTanh,  # noqa: F401
+                         ForwardRelu, ForwardStrictRelu,
+                         ForwardSigmoid, ForwardLog, ForwardTanhLog,
+                         ForwardSinCos, ForwardMul)
+from .dropout import DropoutForward  # noqa: F401
+from .lrn import LRNormalizerForward  # noqa: F401
 from .evaluator import EvaluatorSoftmax, EvaluatorMSE  # noqa: F401
 from .gd import (GradientDescent, GDTanh, GDRelu,  # noqa: F401
-                 GDSigmoid, GDSoftmax)
+                 GDStrictRelu, GDSigmoid, GDSoftmax, GDConv,
+                 GDConvTanh, GDConvRelu, GDConvStrictRelu,
+                 GDConvSigmoid, GDDeconv, GDMaxPooling,
+                 GDMaxAbsPooling, GDAvgPooling, GDStochasticPooling,
+                 GDStochasticAbsPooling, GDActivationTanh,
+                 GDActivationRelu, GDActivationStrictRelu,
+                 GDActivationSigmoid, GDActivationLog,
+                 GDActivationTanhLog, GDActivationSinCos,
+                 GDActivationMul, GDDropout, GDLRNormalizer)
 from .decision import DecisionBase, DecisionGD  # noqa: F401

@@ -1,0 +1,133 @@
+"""Standalone activation units.
+
+Reconstructed znicz capability surface (znicz had an ``activation``
+module of shape-preserving Forward units usable between any two layers:
+ForwardTanh, ForwardRELU (softplus), ForwardStrictRELU, ForwardSigmoid,
+ForwardLog, ForwardTanhLog, ForwardSinCos, ForwardMul).  Each has a
+paired GD registration so ``gd_for`` resolves (see gd.py); the backward
+is autodiff.
+
+TPU note: these are pure elementwise maps — XLA fuses them into the
+producing matmul/conv, so a standalone activation unit costs nothing at
+runtime; keeping them as units preserves the reference's graph
+ergonomics."""
+
+import numpy
+
+from . import nn_units
+from .nn_units import ForwardBase
+
+
+class ActivationForward(ForwardBase):
+    """Shape-preserving elementwise unit."""
+
+    hide_from_registry = True
+    HAS_PARAMS = False
+
+    @property
+    def trainables(self):
+        return {}
+
+    def initialize(self, device=None, **kwargs):
+        super(ActivationForward, self).initialize(device=device,
+                                                  **kwargs)
+        self.output.mem = numpy.zeros(self.input.shape,
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def activation(self, v):
+        raise NotImplementedError()
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        x = read(self.input).astype(jnp.float32)
+        write(self.output, self.activation(x))
+
+
+class ForwardTanh(ActivationForward):
+    MAPPING = "activation_tanh"
+
+    def activation(self, v):
+        return nn_units.act_tanh(v)
+
+
+class ForwardRelu(ActivationForward):
+    """Smooth ReLU: log(1 + e^x) (znicz ``ForwardRELU``)."""
+    MAPPING = "activation_relu"
+
+    def activation(self, v):
+        return nn_units.act_softplus(v)
+
+
+class ForwardStrictRelu(ActivationForward):
+    MAPPING = "activation_str"
+
+    def activation(self, v):
+        return nn_units.act_strict_relu(v)
+
+
+class ForwardSigmoid(ActivationForward):
+    MAPPING = "activation_sigmoid"
+
+    def activation(self, v):
+        return nn_units.act_sigmoid(v)
+
+
+class ForwardLog(ActivationForward):
+    """log(x + sqrt(x² + 1)) — asinh (znicz ``ForwardLog``)."""
+    MAPPING = "activation_log"
+
+    def activation(self, v):
+        import jax.numpy as jnp
+        return jnp.arcsinh(v)
+
+
+class ForwardTanhLog(ActivationForward):
+    """tanh for |x| small, log beyond a threshold (znicz
+    ``ForwardTanhLog``): piecewise activation bounded like tanh but
+    with unbounded gradient support."""
+    MAPPING = "activation_tanhlog"
+    D = 3.0
+    A = 1.7159
+    B = 0.6666
+
+    def activation(self, v):
+        import jax.numpy as jnp
+        t = self.A * jnp.tanh(self.B * v)
+        edge = self.A * jnp.tanh(self.B * self.D)
+        lg = jnp.sign(v) * (edge + jnp.log1p(jnp.abs(v) - self.D))
+        return jnp.where(jnp.abs(v) <= self.D, t, lg)
+
+
+class ForwardSinCos(ActivationForward):
+    """sin on even feature indices, cos on odd (znicz
+    ``ForwardSinCos``)."""
+    MAPPING = "activation_sincos"
+
+    def activation(self, v):
+        import jax.numpy as jnp
+        flat = v.reshape(v.shape[0], -1)
+        idx = jnp.arange(flat.shape[1])
+        out = jnp.where(idx % 2 == 0, jnp.sin(flat), jnp.cos(flat))
+        return out.reshape(v.shape)
+
+
+class ForwardMul(ActivationForward):
+    """y = k·x with a learnable scalar k (znicz ``ForwardMul``)."""
+    MAPPING = "activation_mul"
+    HAS_PARAMS = True
+
+    def __init__(self, workflow, **kwargs):
+        super(ForwardMul, self).__init__(workflow, **kwargs)
+        from ..memory import Vector
+        self.factor = Vector(numpy.ones((), dtype=numpy.float32) *
+                             kwargs.get("factor", 1.0))
+
+    @property
+    def trainables(self):
+        return {"factor": self.factor}
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        x = read(self.input).astype(jnp.float32)
+        write(self.output, params["factor"] * x)

@@ -14,6 +14,7 @@ f32 accumulation via ``preferred_element_type``).
 
 import numpy
 
+from . import nn_units
 from .nn_units import ForwardBase
 
 
@@ -84,28 +85,36 @@ class All2AllTanh(All2All):
     """Scaled tanh activation (znicz used 1.7159·tanh(0.6666·x))."""
 
     MAPPING = "all2all_tanh"
-    A = 1.7159
-    B = 0.6666
+    A = nn_units.TANH_A
 
     def activation(self, v):
-        import jax.numpy as jnp
-        return self.A * jnp.tanh(self.B * v)
+        return nn_units.act_tanh(v)
 
 
 class All2AllRelu(All2All):
+    """Softplus log(1+e^x) — znicz's smooth "RELU" (matches the conv
+    family's ConvRelu)."""
+
     MAPPING = "all2all_relu"
 
     def activation(self, v):
-        import jax.numpy as jnp
-        return jnp.maximum(v, 0)
+        return nn_units.act_softplus(v)
+
+
+class All2AllStrictRelu(All2All):
+    """max(0, x) (znicz ``All2AllStrictRELU``)."""
+
+    MAPPING = "all2all_str"
+
+    def activation(self, v):
+        return nn_units.act_strict_relu(v)
 
 
 class All2AllSigmoid(All2All):
     MAPPING = "all2all_sigmoid"
 
     def activation(self, v):
-        import jax
-        return jax.nn.sigmoid(v)
+        return nn_units.act_sigmoid(v)
 
 
 class All2AllSoftmax(All2All):

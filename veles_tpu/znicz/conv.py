@@ -1,0 +1,200 @@
+"""Convolutional layer units.
+
+Reconstructed znicz capability surface (SURVEY §2.5: "Conv" units;
+BASELINE.json CIFAR-10 / AlexNet parity configs).  A Conv layer slides
+``n_kernels`` filters of size ``ky``×``kx`` over an NHWC input with
+``sliding`` stride and ``padding``, then applies the activation.
+
+TPU-era mapping: one ``lax.conv_general_dilated`` in NHWC/HWIO layout —
+XLA tiles it onto the MXU directly (bf16 operands, f32 accumulation via
+``preferred_element_type``); the activation and bias fuse into the same
+kernel.  No im2col, no hand-written backward: gradients come from
+autodiff of the fused step (see accelerated_units.StepCompiler).
+
+Geometry ergonomics follow the znicz units: ``padding`` is either a
+single int, an (x, y) pair, or a 4-tuple (left, top, right, bottom);
+``sliding`` is an (x, y) pair.  Weight init: normal with stddev
+``weights_stddev`` (default 1/sqrt(fan_in), fan_in = kx·ky·channels).
+"""
+
+import numpy
+
+from . import nn_units
+from .nn_units import ForwardBase
+
+
+def _norm_padding(padding):
+    """→ ((top, bottom), (left, right))."""
+    if padding is None:
+        return ((0, 0), (0, 0))
+    if isinstance(padding, int):
+        return ((padding, padding), (padding, padding))
+    padding = tuple(padding)
+    if len(padding) == 2:
+        px, py = padding
+        return ((py, py), (px, px))
+    if len(padding) == 4:
+        left, top, right, bottom = padding
+        return ((top, bottom), (left, right))
+    raise ValueError("bad padding %r" % (padding,))
+
+
+def _norm_sliding(sliding):
+    if sliding is None:
+        return (1, 1)
+    if isinstance(sliding, int):
+        return (sliding, sliding)
+    sx, sy = tuple(sliding)
+    return (sy, sx)  # row-major (y, x) strides for NHWC
+
+
+class Conv(ForwardBase):
+    """2-D convolution, identity activation (znicz ``Conv``)."""
+
+    MAPPING = "conv"
+
+    def __init__(self, workflow, **kwargs):
+        super(Conv, self).__init__(workflow, **kwargs)
+        self.n_kernels = kwargs["n_kernels"]
+        self.kx = kwargs["kx"]
+        self.ky = kwargs.get("ky", self.kx)
+        self.padding = _norm_padding(kwargs.get("padding"))
+        self.sliding = _norm_sliding(kwargs.get("sliding"))
+
+    def output_spatial(self, in_h, in_w):
+        (pt, pb), (pl, pr) = self.padding
+        sh, sw = self.sliding
+        out_h = (in_h + pt + pb - self.ky) // sh + 1
+        out_w = (in_w + pl + pr - self.kx) // sw + 1
+        return out_h, out_w
+
+    def initialize(self, device=None, **kwargs):
+        super(Conv, self).initialize(device=device, **kwargs)
+        batch, in_h, in_w, in_ch = self.input.shape
+        fan_in = self.kx * self.ky * in_ch
+        if not self.weights:
+            stddev = self.weights_stddev or (1.0 / numpy.sqrt(fan_in))
+            w = numpy.zeros((self.ky, self.kx, in_ch, self.n_kernels),
+                            dtype=numpy.float32)
+            self.rand().fill_normal(w, stddev=stddev)
+            self.weights.mem = w
+            self.weights.initialize(self.device)
+        if self.include_bias and not self.bias:
+            b = numpy.zeros(self.n_kernels, dtype=numpy.float32)
+            if self.bias_stddev:
+                self.rand().fill_normal(b, stddev=self.bias_stddev)
+            self.bias.mem = b
+            self.bias.initialize(self.device)
+        out_h, out_w = self.output_spatial(in_h, in_w)
+        self.output.mem = numpy.zeros(
+            (batch, out_h, out_w, self.n_kernels), dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def activation(self, v):
+        return v
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        from jax import lax
+        x = read(self.input)
+        w = params["weights"]
+        # f32 operands + DEFAULT precision: XLA runs the MXU in bf16
+        # passes with f32 accumulation on TPU (casting operands to
+        # bf16 manually breaks the conv transpose rule under autodiff,
+        # which requires matching dtypes).
+        y = lax.conv_general_dilated(
+            x.astype(jnp.float32), w.astype(jnp.float32),
+            window_strides=self.sliding,
+            padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.include_bias:
+            y = y + params["bias"]
+        write(self.output, self.activation(y))
+
+
+class ConvTanh(Conv):
+    """Scaled tanh (znicz 1.7159·tanh(0.6666·x))."""
+
+    MAPPING = "conv_tanh"
+
+    def activation(self, v):
+        return nn_units.act_tanh(v)
+
+
+class ConvRelu(Conv):
+    """Softplus log(1+e^x) — znicz's smooth "RELU" conv."""
+
+    MAPPING = "conv_relu"
+
+    def activation(self, v):
+        return nn_units.act_softplus(v)
+
+
+class ConvStrictRelu(Conv):
+    """max(0, x) (znicz ``ConvStrictRELU``) — the AlexNet activation."""
+
+    MAPPING = "conv_str"
+
+    def activation(self, v):
+        return nn_units.act_strict_relu(v)
+
+
+class ConvSigmoid(Conv):
+    MAPPING = "conv_sigmoid"
+
+    def activation(self, v):
+        return nn_units.act_sigmoid(v)
+
+
+class Deconv(ForwardBase):
+    """Transposed convolution with weights TIED to a forward Conv
+    (znicz ``Deconv`` — the decoder half of conv autoencoder
+    pretraining; ``get_weights_from`` names the conv whose filters are
+    reused, never trained through this unit's own slot)."""
+
+    MAPPING = "deconv"
+    HAS_PARAMS = False
+
+    def __init__(self, workflow, **kwargs):
+        super(Deconv, self).__init__(workflow, **kwargs)
+        self.conv = kwargs["get_weights_from"]
+        self.include_bias = False
+
+    @property
+    def trainables(self):
+        return {}  # tied weights belong to (and are trained via) conv
+
+    def initialize(self, device=None, **kwargs):
+        if not self.conv.is_initialized:
+            raise AttributeError(
+                "%s: tied conv %s not initialized yet" %
+                (self.name, self.conv.name))
+        super(Deconv, self).initialize(device=device, **kwargs)
+        batch = self.input.shape[0]
+        out_shape = self.conv.input.shape[1:]
+        self.output.mem = numpy.zeros((batch,) + tuple(out_shape),
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        x = read(self.input).astype(jnp.float32)
+        w = read(self.conv.weights).astype(jnp.float32)
+        conv = self.conv
+        in_shape = (x.shape[0],) + tuple(conv.input.shape[1:])
+
+        def paired_conv(inp):
+            return lax.conv_general_dilated(
+                inp, w, window_strides=conv.sliding,
+                padding=conv.padding,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        # Exact gradient-of-conv geometry: the VJP of the paired conv
+        # (conv is linear in its input, so the zeros primal is free
+        # and the cotangent pullback IS the transposed conv —
+        # guaranteed to produce conv.input's spatial dims for ANY
+        # stride/padding combination).
+        _, vjp = jax.vjp(paired_conv, jnp.zeros(in_shape, x.dtype))
+        write(self.output, vjp(x)[0])

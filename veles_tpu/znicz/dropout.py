@@ -1,0 +1,49 @@
+"""Dropout unit.
+
+Reconstructed znicz capability surface (znicz ``dropout.DropoutForward``
+with ``dropout_ratio``; its GD unit routed gradients through the same
+mask).  Inverted dropout: training scales kept activations by
+1/(1-ratio) so inference is the identity — no separate rescale pass.
+
+TPU note: the mask comes from the step's keyed PRNG (``ctx.next_key``),
+so a block-mode scan gives every tick an independent mask while staying
+reproducible; autodiff routes gradients through the same mask
+automatically (the reference needed a paired GD unit for that).
+"""
+
+import numpy
+
+from ..accelerated_units import select_by_training
+from .nn_units import ForwardBase
+
+
+class DropoutForward(ForwardBase):
+    MAPPING = "dropout"
+    HAS_PARAMS = False
+
+    def __init__(self, workflow, **kwargs):
+        super(DropoutForward, self).__init__(workflow, **kwargs)
+        self.dropout_ratio = kwargs.get("dropout_ratio", 0.5)
+
+    @property
+    def trainables(self):
+        return {}
+
+    def initialize(self, device=None, **kwargs):
+        super(DropoutForward, self).initialize(device=device, **kwargs)
+        self.output.mem = numpy.zeros(self.input.shape,
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax
+        import jax.numpy as jnp
+        x = read(self.input).astype(jnp.float32)
+        keep = 1.0 - self.dropout_ratio
+
+        def train_branch():
+            mask = jax.random.bernoulli(ctx.next_key(), keep, x.shape)
+            return x * mask / keep
+
+        write(self.output, select_by_training(ctx, train_branch,
+                                              lambda: x))

@@ -108,13 +108,20 @@ class EvaluatorMSE(EvaluatorBase):
     def __init__(self, workflow, **kwargs):
         super(EvaluatorMSE, self).__init__(workflow, **kwargs)
         self.target = None
+        # Autoencoder fallback: when ``target`` stays unallocated
+        # (loader serves no targets), reconstruct ``fallback_target``
+        # (usually the input data) instead.
+        self.fallback_target = None
         self.root_metric = kwargs.get("root", True)
         self.demand("target", "mask", "minibatch_class_vec")
 
     def tforward(self, read, write, params, ctx, state=None):
         import jax.numpy as jnp
+        tgt = self.target
+        if not tgt and self.fallback_target is not None:
+            tgt = self.fallback_target
         y = read(self.input).astype(jnp.float32)
-        t = read(self.target).astype(jnp.float32)
+        t = read(tgt).astype(jnp.float32)
         mask = read(self.mask)
         batch = y.shape[0]
         n_valid = jnp.maximum(mask.sum(), 1.0)

@@ -31,9 +31,104 @@ class GDRelu(GradientDescentBase):
     MAPPING = "all2all_relu"
 
 
+class GDStrictRelu(GradientDescentBase):
+    MAPPING = "all2all_str"
+
+
 class GDSigmoid(GradientDescentBase):
     MAPPING = "all2all_sigmoid"
 
 
 class GDSoftmax(GradientDescentBase):
     MAPPING = "softmax"
+
+
+# -- conv family (znicz gd_conv) -------------------------------------------
+
+class GDConv(GradientDescentBase):
+    MAPPING = "conv"
+
+
+class GDConvTanh(GradientDescentBase):
+    MAPPING = "conv_tanh"
+
+
+class GDConvRelu(GradientDescentBase):
+    MAPPING = "conv_relu"
+
+
+class GDConvStrictRelu(GradientDescentBase):
+    MAPPING = "conv_str"
+
+
+class GDConvSigmoid(GradientDescentBase):
+    MAPPING = "conv_sigmoid"
+
+
+class GDDeconv(GradientDescentBase):
+    MAPPING = "deconv"
+
+
+# -- pooling family (znicz gd_pooling; parameterless, kept so the
+# layer→trainer pairing covers whole-stack construction) -------------------
+
+class GDMaxPooling(GradientDescentBase):
+    MAPPING = "max_pooling"
+
+
+class GDMaxAbsPooling(GradientDescentBase):
+    MAPPING = "maxabs_pooling"
+
+
+class GDAvgPooling(GradientDescentBase):
+    MAPPING = "avg_pooling"
+
+
+class GDStochasticPooling(GradientDescentBase):
+    MAPPING = "stochastic_pooling"
+
+
+class GDStochasticAbsPooling(GradientDescentBase):
+    MAPPING = "stochastic_abs_pooling"
+
+
+# -- activations / dropout / LRN -------------------------------------------
+
+class GDActivationTanh(GradientDescentBase):
+    MAPPING = "activation_tanh"
+
+
+class GDActivationRelu(GradientDescentBase):
+    MAPPING = "activation_relu"
+
+
+class GDActivationStrictRelu(GradientDescentBase):
+    MAPPING = "activation_str"
+
+
+class GDActivationSigmoid(GradientDescentBase):
+    MAPPING = "activation_sigmoid"
+
+
+class GDActivationLog(GradientDescentBase):
+    MAPPING = "activation_log"
+
+
+class GDActivationTanhLog(GradientDescentBase):
+    MAPPING = "activation_tanhlog"
+
+
+class GDActivationSinCos(GradientDescentBase):
+    MAPPING = "activation_sincos"
+
+
+class GDActivationMul(GradientDescentBase):
+    MAPPING = "activation_mul"
+
+
+class GDDropout(GradientDescentBase):
+    MAPPING = "dropout"
+
+
+class GDLRNormalizer(GradientDescentBase):
+    MAPPING = "norm"

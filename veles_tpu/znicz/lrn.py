@@ -1,0 +1,55 @@
+"""Local response normalization (cross-channel).
+
+Reconstructed znicz capability surface (znicz ``normalization.
+LRNormalizerForward`` used by the AlexNet-era conv samples):
+
+    y = x / (k + alpha/n · Σ_{j∈window} x_j²)^beta
+
+with the sum over ``n`` adjacent channels (AlexNet: k=2, n=5,
+alpha=1e-4, beta=0.75; znicz defaults matched).
+
+TPU note: expressed as a windowed reduction over the channel axis
+(``lax.reduce_window``) that XLA fuses with the surrounding elementwise
+math; backward is autodiff (the reference had a dedicated GD unit)."""
+
+import numpy
+
+from .nn_units import ForwardBase
+
+
+class LRNormalizerForward(ForwardBase):
+    MAPPING = "norm"
+    HAS_PARAMS = False
+
+    def __init__(self, workflow, **kwargs):
+        super(LRNormalizerForward, self).__init__(workflow, **kwargs)
+        self.alpha = kwargs.get("alpha", 1e-4)
+        self.beta = kwargs.get("beta", 0.75)
+        self.k = kwargs.get("k", 2.0)
+        self.n = kwargs.get("n", 5)
+
+    @property
+    def trainables(self):
+        return {}
+
+    def initialize(self, device=None, **kwargs):
+        super(LRNormalizerForward, self).initialize(device=device,
+                                                    **kwargs)
+        self.output.mem = numpy.zeros(self.input.shape,
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        from jax import lax
+        x = read(self.input).astype(jnp.float32)
+        half = self.n // 2
+        sq = x * x
+        window = (1,) * (x.ndim - 1) + (self.n,)
+        strides = (1,) * x.ndim
+        pad = tuple((0, 0) for _ in range(x.ndim - 1)) + \
+            ((half, self.n - 1 - half),)
+        ssum = lax.reduce_window(sq, 0.0, lax.add, window, strides,
+                                 pad)
+        denom = (self.k + (self.alpha / self.n) * ssum) ** self.beta
+        write(self.output, x / denom)

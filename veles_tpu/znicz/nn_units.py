@@ -23,6 +23,35 @@ from ..memory import Vector
 from ..registry import MappedUnitRegistry
 
 
+# -- shared activation bodies (one definition for the all2all / conv /
+# standalone-activation families; znicz constants) -------------------------
+
+#: znicz scaled-tanh constants (1.7159·tanh(0.6666·x)).
+TANH_A = 1.7159
+TANH_B = 0.6666
+
+
+def act_tanh(v):
+    import jax.numpy as jnp
+    return TANH_A * jnp.tanh(TANH_B * v)
+
+
+def act_softplus(v):
+    """znicz "RELU": log(1 + e^x)."""
+    import jax
+    return jax.nn.softplus(v)
+
+
+def act_strict_relu(v):
+    import jax.numpy as jnp
+    return jnp.maximum(v, 0)
+
+
+def act_sigmoid(v):
+    import jax
+    return jax.nn.sigmoid(v)
+
+
 class ForwardUnitRegistry(MappedUnitRegistry):
     """String → forward-layer class (the reference's MappedUnitRegistry
     role for znicz layers, unit_registry.py:178)."""
@@ -46,6 +75,11 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
     """A forward layer unit (znicz ``Forward`` analogue)."""
 
     hide_from_registry = True
+
+    #: Whether this layer type owns trainable parameters — static so
+    #: workflow builders can pair GD units BEFORE weights are
+    #: allocated (trainables itself is dynamic, post-initialize).
+    HAS_PARAMS = True
 
     def __init__(self, workflow, **kwargs):
         super(ForwardBase, self).__init__(workflow, **kwargs)

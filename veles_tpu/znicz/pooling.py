@@ -1,0 +1,194 @@
+"""Pooling layer units.
+
+Reconstructed znicz capability surface (SURVEY §2.5: "Pooling" units):
+max, average and stochastic pooling over NHWC inputs with kernel
+``ky``×``kx`` and ``sliding`` stride.
+
+TPU-era mapping: ``lax.reduce_window`` — XLA lowers it to a fused
+windowed reduction, and the backward (argmax routing for max pooling)
+is derived by autodiff instead of the reference's stored-offsets
+kernel.  Stochastic pooling (Zeiler & Fergus 2013, the znicz
+``StochasticPooling``) samples a window element with probability
+proportional to its activation during training and uses the
+probability-weighted average at inference.
+"""
+
+import numpy
+
+from .nn_units import ForwardBase
+from .conv import _norm_padding, _norm_sliding
+
+
+class Pooling(ForwardBase):
+    """Common geometry for pooling units."""
+
+    hide_from_registry = True
+    HAS_PARAMS = False
+
+    def __init__(self, workflow, **kwargs):
+        super(Pooling, self).__init__(workflow, **kwargs)
+        self.kx = kwargs["kx"]
+        self.ky = kwargs.get("ky", self.kx)
+        self.sliding = _norm_sliding(kwargs.get("sliding", (self.kx,
+                                                            self.ky)))
+        self.padding = _norm_padding(kwargs.get("padding"))
+        self.include_bias = False
+
+    @property
+    def trainables(self):
+        return {}
+
+    def output_spatial(self, in_h, in_w):
+        (pt, pb), (pl, pr) = self.padding
+        sh, sw = self.sliding
+        # Ceil-mode window count (znicz pooled the ragged tail too).
+        out_h = -(-(in_h + pt + pb - self.ky) // sh) + 1
+        out_w = -(-(in_w + pl + pr - self.kx) // sw) + 1
+        return out_h, out_w
+
+    def _window_dims(self):
+        return (1, self.ky, self.kx, 1)
+
+    def _window_strides(self):
+        return (1,) + self.sliding + (1,)
+
+    def _window_padding(self, in_h, in_w):
+        """SAME-style explicit padding that covers the ragged tail."""
+        (pt, pb), (pl, pr) = self.padding
+        sh, sw = self.sliding
+        out_h, out_w = self.output_spatial(in_h, in_w)
+        need_h = (out_h - 1) * sh + self.ky - (in_h + pt)
+        need_w = (out_w - 1) * sw + self.kx - (in_w + pl)
+        return ((0, 0), (pt, max(pb, need_h)), (pl, max(pr, need_w)),
+                (0, 0))
+
+    def initialize(self, device=None, **kwargs):
+        super(Pooling, self).initialize(device=device, **kwargs)
+        batch, in_h, in_w, ch = self.input.shape
+        out_h, out_w = self.output_spatial(in_h, in_w)
+        self.output.mem = numpy.zeros((batch, out_h, out_w, ch),
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+
+class MaxPooling(Pooling):
+    """Max over each window; znicz's ``MaxPooling`` (the
+    ``MaxAbsPooling`` variant keeps the signed value of the max-|x|
+    element)."""
+
+    MAPPING = "max_pooling"
+    ABS = False
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        from jax import lax
+        x = read(self.input).astype(jnp.float32)
+        _, in_h, in_w, _ = x.shape
+        pad = self._window_padding(in_h, in_w)
+        if self.ABS:
+            # Signed value of the max-absolute element: take the max
+            # over |x| and recover the sign via paired reductions.
+            hi = lax.reduce_window(
+                x, -jnp.inf, lax.max, self._window_dims(),
+                self._window_strides(), pad)
+            lo = lax.reduce_window(
+                x, jnp.inf, lax.min, self._window_dims(),
+                self._window_strides(), pad)
+            y = jnp.where(-lo > hi, lo, hi)
+        else:
+            y = lax.reduce_window(
+                x, -jnp.inf, lax.max, self._window_dims(),
+                self._window_strides(), pad)
+        write(self.output, y)
+
+
+class MaxAbsPooling(MaxPooling):
+    MAPPING = "maxabs_pooling"
+    ABS = True
+
+
+class AvgPooling(Pooling):
+    """Mean over each window (znicz ``AvgPooling``)."""
+
+    MAPPING = "avg_pooling"
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        from jax import lax
+        x = read(self.input).astype(jnp.float32)
+        _, in_h, in_w, _ = x.shape
+        pad = self._window_padding(in_h, in_w)
+        ssum = lax.reduce_window(
+            x, 0.0, lax.add, self._window_dims(),
+            self._window_strides(), pad)
+        # Divide by the true (unpadded) window population.
+        ones = jnp.ones_like(x)
+        count = lax.reduce_window(
+            ones, 0.0, lax.add, self._window_dims(),
+            self._window_strides(), pad)
+        write(self.output, ssum / count)
+
+
+class StochasticPooling(Pooling):
+    """Stochastic pooling (znicz ``StochasticPooling``): training picks
+    one window element with probability ∝ its (non-negative)
+    activation; inference outputs the probability-weighted mean.
+    Restricted to non-overlapping windows (sliding == kernel), the only
+    configuration znicz's samples used."""
+
+    MAPPING = "stochastic_pooling"
+    ABS = False
+
+    def __init__(self, workflow, **kwargs):
+        super(StochasticPooling, self).__init__(workflow, **kwargs)
+        # Geometry restrictions checked up front so output_spatial
+        # and the traced patches view always agree.
+        if self.sliding != (self.ky, self.kx):
+            raise ValueError(
+                "%s supports only sliding == kernel" % self)
+        if self.padding != ((0, 0), (0, 0)):
+            raise ValueError("%s does not support padding" % self)
+
+    def _patches(self, x):
+        """(B, OH, OW, ky·kx, C) view of non-overlapping windows,
+        padding the ragged tail with zeros."""
+        import jax.numpy as jnp
+        b, h, w, c = x.shape
+        oh = -(-h // self.ky)
+        ow = -(-w // self.kx)
+        x = jnp.pad(x, ((0, 0), (0, oh * self.ky - h),
+                        (0, ow * self.kx - w), (0, 0)))
+        x = x.reshape(b, oh, self.ky, ow, self.kx, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(b, oh, ow, self.ky * self.kx, c)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax
+        import jax.numpy as jnp
+        x = read(self.input).astype(jnp.float32)
+        p = self._patches(jnp.abs(x) if self.ABS else x)
+        v = self._patches(x)
+        w = jnp.maximum(p, 0.0)
+        tot = w.sum(axis=3, keepdims=True)
+        # All-zero windows fall back to uniform.
+        k = w.shape[3]
+        probs = jnp.where(tot > 0, w / jnp.maximum(tot, 1e-30),
+                          1.0 / k)
+        from ..accelerated_units import select_by_training
+
+        def train_branch():
+            g = jax.random.gumbel(ctx.next_key(), probs.shape)
+            pick = jnp.argmax(jnp.log(probs + 1e-30) + g, axis=3)
+            return jnp.take_along_axis(
+                v, pick[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+
+        def eval_branch():
+            return (probs * v).sum(axis=3)
+
+        write(self.output, select_by_training(
+            ctx, train_branch, eval_branch))
+
+
+class StochasticAbsPooling(StochasticPooling):
+    MAPPING = "stochastic_abs_pooling"
+    ABS = True
