@@ -1,0 +1,84 @@
+"""AlexNet pipeline gate — parity config #3 (byte originals +
+in-step mean-disp normalization; reference:
+veles/mean_disp_normalizer.py, ocl/mean_disp_normalizer.cl).
+
+The full 227px/1000-class geometry runs in bench.py on real TPU; here
+a reduced stack exercises the same pipeline (uint8 gather → normalizer
+→ conv → LRN → pool → dropout → softmax) end to end on CPU."""
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.launcher import Launcher
+from veles_tpu.memory import Vector
+from veles_tpu.mean_disp_normalizer import MeanDispNormalizer
+from veles_tpu.znicz.samples.imagenet import (AlexNetWorkflow,
+                                              ImagenetLoader)
+
+
+def test_mean_disp_normalizer_unit():
+    wf = DummyWorkflow()
+    unit = MeanDispNormalizer(wf)
+    rng = numpy.random.RandomState(0)
+    x = rng.randint(0, 256, size=(4, 8, 8, 3)).astype(numpy.uint8)
+    mean = rng.rand(8, 8, 3).astype(numpy.float32) * 128
+    rdisp = (1.0 / (rng.rand(8, 8, 3).astype(numpy.float32) * 60 + 4))
+    unit.input = Vector(x)
+    unit.mean = Vector(mean)
+    unit.rdisp = Vector(rdisp)
+    unit.initialize()
+    unit.eager_run()
+    unit.output.map_read()
+    want = (x.astype(numpy.float32) - mean) * rdisp
+    numpy.testing.assert_allclose(unit.output.mem, want, rtol=1e-5)
+
+
+def tiny_layers(n_classes):
+    gd = {"learning_rate": 0.02, "gradient_moment": 0.9}
+    return [
+        {"type": "conv_str",
+         "->": {"n_kernels": 16, "kx": 5, "ky": 5, "sliding": (2, 2),
+                "weights_stddev": 0.05}, "<-": dict(gd)},
+        {"type": "norm", "->": {}},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3,
+                                       "sliding": (2, 2)}},
+        {"type": "dropout", "->": {"dropout_ratio": 0.2}},
+        {"type": "softmax",
+         "->": {"output_sample_shape": (n_classes,),
+                "weights_stddev": 0.05}, "<-": dict(gd)},
+    ]
+
+
+@pytest.fixture(scope="module")
+def trained():
+    prng.reset()
+    prng.get(0).seed(31)
+    launcher = Launcher()
+    wf = AlexNetWorkflow(
+        launcher, layers=tiny_layers(10), minibatch_size=100,
+        max_epochs=6,
+        loader_config={"sim_image_size": 32, "sim_classes": 10,
+                       "sim_train": 600, "sim_valid": 200})
+    launcher.initialize()
+    launcher.run()
+    return wf
+
+
+def test_byte_pipeline_converges(trained):
+    results = trained.gather_results()
+    # Synthetic classes differ by mean shift — the normalizer +
+    # conv stack must separate them.
+    assert results["min_validation_err"] < 0.30
+
+
+def test_originals_stay_uint8(trained):
+    """The HBM-resident dataset must remain bytes (the design point:
+    4× bandwidth saving; normalization happens in-step)."""
+    assert trained.loader.original_data.devmem.dtype == numpy.uint8
+
+
+def test_normalizer_in_fused_step(trained):
+    names = [type(u).__name__ for u in trained.compiler.forward_units]
+    assert "MeanDispNormalizer" in names

@@ -1,0 +1,55 @@
+"""Mean/dispersion normalizer unit.
+
+Capability parity with the reference unit (reference:
+veles/mean_disp_normalizer.py — ``MeanDispNormalizer:50``, kernels
+ocl/mean_disp_normalizer.cl, cuda/mean_disp_normalizer.cu): the
+byte-image pipeline's on-device normalization
+
+    y = (x − mean) · rdisp
+
+with per-feature ``mean`` and reciprocal-dispersion ``rdisp`` arrays
+computed by the loader's dataset analysis (the ImageNet/AlexNet path).
+
+TPU-era mapping: a TracedUnit — the subtract-multiply fuses into the
+first conv's XLA computation, so uint8 originals stay uint8 in HBM
+(4× less bandwidth than pre-normalized floats) and the float image
+never exists in memory; this is the reference's exact motivation
+(keep originals as bytes, normalize on device) carried to XLA.
+"""
+
+import numpy
+
+from .accelerated_units import TracedUnit
+from .memory import Vector
+
+
+class MeanDispNormalizer(TracedUnit):
+    """y = (x − mean)·rdisp, traced into the fused step
+    (reference: mean_disp_normalizer.py:50)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(MeanDispNormalizer, self).__init__(workflow, **kwargs)
+        self.view_group = "WORKER"
+        self.input = None   # linked: loader minibatch data (any dtype)
+        self.mean = None    # linked: per-feature mean (sample shape)
+        self.rdisp = None   # linked: per-feature 1/dispersion
+        self.output = Vector()
+        self.demand("input", "mean", "rdisp")
+
+    def initialize(self, device=None, **kwargs):
+        super(MeanDispNormalizer, self).initialize(device=device,
+                                                   **kwargs)
+        self.output.mem = numpy.zeros(self.input.shape,
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def step_const_vectors(self):
+        return [v for v in (self.mean, self.rdisp)
+                if isinstance(v, Vector)]
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        x = read(self.input).astype(jnp.float32)
+        mean = read(self.mean).astype(jnp.float32)
+        rdisp = read(self.rdisp).astype(jnp.float32)
+        write(self.output, (x - mean) * rdisp)

@@ -1,0 +1,209 @@
+"""ImageNet AlexNet workflow — parity config #3
+(BASELINE.json: "znicz ImageNet AlexNet workflow (fullbatch loader +
+mean_disp_normalizer)"; north-star perf config).
+
+The reference pipeline kept preprocessed byte images device-resident
+and normalized on device with the mean_disp_normalizer kernel
+(reference: veles/mean_disp_normalizer.py, ocl/mean_disp_normalizer.cl,
+veles/loader/fullbatch.py).  Here that is: uint8 originals in HBM,
+in-step gather, and a traced (x−mean)·rdisp that XLA fuses into conv1 —
+the float image never materializes in memory.
+
+Graph: classic AlexNet — conv96@11×11s4 → LRN → maxpool3s2 →
+conv256@5×5p2 → LRN → maxpool → conv384@3×3p1 → conv384 → conv256 →
+maxpool → fc4096+dropout → fc4096+dropout → softmax1000 — the whole
+tick one jitted XLA computation, convs in bf16 MXU passes.
+
+Dataset: preprocessed numpy archives under
+``root.common.dirs.datasets/imagenet`` (``{train,valid}_data.npy``
+uint8 NHWC + ``{train,valid}_labels.npy`` int32) when present;
+otherwise a synthetic uint8 fallback sized by kwargs (tests + perf
+benches use it: the bench measures compute, not JPEG decode).
+"""
+
+import os
+
+import numpy
+
+from ...config import root, get as config_get
+from ...loader.fullbatch import FullBatchLoader
+from ...mean_disp_normalizer import MeanDispNormalizer
+from ..standard_workflow import StandardWorkflow
+
+
+class ImagenetLoader(FullBatchLoader):
+    """Device-resident uint8 image loader with mean/rdisp analysis
+    (the reference AlexNet path's loader contract)."""
+
+    MAPPING = "imagenet_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super(ImagenetLoader, self).__init__(workflow, **kwargs)
+        from ...memory import Vector
+        self.mean = Vector()
+        self.rdisp = Vector()
+        # Synthetic-fallback geometry.
+        self.sim_image_size = kwargs.get("sim_image_size", 227)
+        self.sim_classes = kwargs.get("sim_classes", 1000)
+        self.sim_train = kwargs.get("sim_train", 2048)
+        self.sim_valid = kwargs.get("sim_valid", 256)
+
+    def load_data(self):
+        d = os.path.join(config_get(root.common.dirs.datasets, "."),
+                         "imagenet")
+        names = ("train_data.npy", "train_labels.npy",
+                 "valid_data.npy", "valid_labels.npy")
+        paths = [os.path.join(d, n) for n in names]
+        if all(map(os.path.isfile, paths)):
+            self._load_npy(*paths)
+        else:
+            self._load_synthetic()
+        self._analyze_mean_disp()
+
+    def _load_npy(self, train_d, train_l, valid_d, valid_l):
+        train = numpy.load(train_d)
+        train_labels = numpy.load(train_l).astype(numpy.int32)
+        valid = numpy.load(valid_d)
+        valid_labels = numpy.load(valid_l).astype(numpy.int32)
+        self.original_data.mem = numpy.concatenate([valid, train])
+        self.original_labels.mem = numpy.concatenate(
+            [valid_labels, train_labels])
+        self.class_lengths = [0, len(valid), len(train)]
+        self.info("loaded imagenet npy: %d train, %d validation",
+                  len(train), len(valid))
+
+    def _load_synthetic(self):
+        s = self.sim_image_size
+        n = self.sim_train + self.sim_valid
+        rng = numpy.random.RandomState(0)
+        labels = (numpy.arange(n) % self.sim_classes).astype(
+            numpy.int32)
+        rng.shuffle(labels)
+        # Class-dependent spatial frequency/phase patterns + noise,
+        # quantized to bytes: learnable by a conv stack, and the
+        # uint8 → mean-disp path is identical to the real pipeline.
+        yy, xx = numpy.mgrid[0:s, 0:s].astype(numpy.float32) / (s - 1)
+        data = numpy.empty((n, s, s, 3), dtype=numpy.uint8)
+        for i, lab in enumerate(labels):
+            freq = 1.0 + (lab % 7)
+            phase = (lab // 7) * 0.7
+            pattern = numpy.sin(2 * numpy.pi * freq * xx + phase) * \
+                numpy.cos(2 * numpy.pi * freq * yy + phase)
+            img = pattern[:, :, None] * 80.0 + 128.0 + \
+                rng.normal(0, 20.0, (s, s, 3))
+            data[i] = numpy.clip(img, 0, 255).astype(numpy.uint8)
+        self.original_data.mem = data
+        self.original_labels.mem = labels
+        self.class_lengths = [0, self.sim_valid, self.sim_train]
+        self.info("imagenet files absent — synthetic fallback: "
+                  "%d train, %d validation (%dpx, %d classes)",
+                  self.sim_train, self.sim_valid, s, self.sim_classes)
+
+    def _analyze_mean_disp(self):
+        """Train-set per-pixel mean and reciprocal dispersion
+        (the reference loader's dataset analysis feeding
+        mean_disp_normalizer)."""
+        train_start = self.class_lengths[0] + self.class_lengths[1]
+        train = self.original_data.mem[train_start:]
+        mean = train.mean(axis=0).astype(numpy.float32)
+        disp = train.astype(numpy.float32).std(axis=0)
+        self.mean.mem = mean
+        self.rdisp.mem = (1.0 / numpy.maximum(disp, 1e-3)).astype(
+            numpy.float32)
+
+
+def alexnet_layers(n_classes=1000, lr=0.01, moment=0.9, decay=5e-4):
+    gd = {"learning_rate": lr, "gradient_moment": moment,
+          "weights_decay": decay}
+    return [
+        {"type": "conv_str",
+         "->": {"n_kernels": 96, "kx": 11, "ky": 11,
+                "sliding": (4, 4), "weights_stddev": 0.01,
+                "bias_stddev": 0}, "<-": dict(gd)},
+        {"type": "norm", "->": {"alpha": 1e-4, "beta": 0.75, "n": 5,
+                                "k": 2.0}},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3,
+                                       "sliding": (2, 2)}},
+        {"type": "conv_str",
+         "->": {"n_kernels": 256, "kx": 5, "ky": 5, "padding": 2,
+                "weights_stddev": 0.01}, "<-": dict(gd)},
+        {"type": "norm", "->": {"alpha": 1e-4, "beta": 0.75, "n": 5,
+                                "k": 2.0}},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3,
+                                       "sliding": (2, 2)}},
+        {"type": "conv_str",
+         "->": {"n_kernels": 384, "kx": 3, "ky": 3, "padding": 1,
+                "weights_stddev": 0.01}, "<-": dict(gd)},
+        {"type": "conv_str",
+         "->": {"n_kernels": 384, "kx": 3, "ky": 3, "padding": 1,
+                "weights_stddev": 0.01}, "<-": dict(gd)},
+        {"type": "conv_str",
+         "->": {"n_kernels": 256, "kx": 3, "ky": 3, "padding": 1,
+                "weights_stddev": 0.01}, "<-": dict(gd)},
+        {"type": "max_pooling", "->": {"kx": 3, "ky": 3,
+                                       "sliding": (2, 2)}},
+        {"type": "all2all_str",
+         "->": {"output_sample_shape": (4096,),
+                "weights_stddev": 0.005}, "<-": dict(gd)},
+        {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+        {"type": "all2all_str",
+         "->": {"output_sample_shape": (4096,),
+                "weights_stddev": 0.005}, "<-": dict(gd)},
+        {"type": "dropout", "->": {"dropout_ratio": 0.5}},
+        {"type": "softmax",
+         "->": {"output_sample_shape": (n_classes,),
+                "weights_stddev": 0.01}, "<-": dict(gd)},
+    ]
+
+
+class AlexNetWorkflow(StandardWorkflow):
+    """The AlexNet training workflow with in-step byte normalization."""
+
+    def __init__(self, workflow, layers=None, minibatch_size=256,
+                 learning_rate=0.01, gradient_moment=0.9,
+                 weights_decay=5e-4, max_epochs=None,
+                 fail_iterations=10, loader_cls=ImagenetLoader,
+                 loader_config=None, n_classes=1000, **kwargs):
+        cfg = {"minibatch_size": minibatch_size}
+        cfg.update(loader_config or {})
+        super(AlexNetWorkflow, self).__init__(
+            workflow,
+            layers=layers or alexnet_layers(
+                n_classes, learning_rate, gradient_moment,
+                weights_decay),
+            loader_cls=loader_cls, loader_config=cfg,
+            decision_config={"max_epochs": max_epochs,
+                             "fail_iterations": fail_iterations},
+            loss_function="softmax", **kwargs)
+
+    def link_forwards(self):
+        """Inserts the mean-disp normalizer between the loader's byte
+        gather and conv1 (the reference AlexNet pipeline shape)."""
+        self.normalizer = MeanDispNormalizer(self)
+        self.normalizer.link_from(self.loader)
+        self.normalizer.input = self.loader.minibatch_data
+        self.normalizer.mean = self.loader.mean
+        self.normalizer.rdisp = self.loader.rdisp
+
+        prev, prev_vec = self.normalizer, self.normalizer.output
+        from ..nn_units import ForwardUnitRegistry
+        for i, cfg in enumerate(self.layer_configs):
+            cfg = dict(cfg)
+            type_name = cfg.pop("type")
+            fwd_kwargs = dict(cfg.get("->", {}))
+            cls = ForwardUnitRegistry.get_factory(type_name)
+            unit = cls(self, name="%s%d" % (type_name, i),
+                       **fwd_kwargs)
+            unit.link_from(prev)
+            unit.input = prev_vec
+            self.forwards.append(unit)
+            prev, prev_vec = unit, unit.output
+        return self.forwards
+
+
+def run(load, main):
+    load(AlexNetWorkflow,
+         minibatch_size=config_get(root.imagenet.minibatch_size, 256),
+         learning_rate=config_get(root.imagenet.learning_rate, 0.01),
+         max_epochs=config_get(root.imagenet.max_epochs, 90))
+    main()
