@@ -14,8 +14,7 @@ from veles_tpu.dummy import DummyWorkflow
 from veles_tpu.launcher import Launcher
 from veles_tpu.memory import Vector
 from veles_tpu.mean_disp_normalizer import MeanDispNormalizer
-from veles_tpu.znicz.samples.imagenet import (AlexNetWorkflow,
-                                              ImagenetLoader)
+from veles_tpu.znicz.samples.imagenet import AlexNetWorkflow
 
 
 def test_mean_disp_normalizer_unit():

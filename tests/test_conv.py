@@ -116,6 +116,29 @@ def test_lrn_formula():
                                   rtol=1e-5)
 
 
+@pytest.mark.parametrize("n", [3, 4, 5])
+def test_lrn_matches_closed_form(n):
+    """Pins the shifted-slice-add windowed sum against the clipped
+    channel window computed directly in numpy (guards the pad/slice
+    bounds of the fused formulation)."""
+    rng = numpy.random.RandomState(7)
+    c = 9
+    x = rng.normal(0, 2.0, (2, 3, 3, c)).astype(numpy.float32)
+    alpha, beta, k = 2e-4, 0.7, 1.5
+    unit = _unit_with_input(LRNormalizerForward, x, alpha=alpha,
+                            beta=beta, k=k, n=n)
+    unit.eager_run()
+    unit.output.map_read()
+    half = n // 2
+    want = numpy.empty_like(x)
+    for j in range(c):
+        lo, hi = max(0, j - half), min(c, j + (n - 1 - half) + 1)
+        ssum = (x[..., lo:hi] ** 2).sum(axis=-1)
+        want[..., j] = x[..., j] / (k + (alpha / n) * ssum) ** beta
+    numpy.testing.assert_allclose(unit.output.mem, want, rtol=2e-5,
+                                  atol=2e-6)
+
+
 def test_dropout_inference_identity():
     x = numpy.random.RandomState(0).rand(4, 10).astype(numpy.float32)
     unit = _unit_with_input(DropoutForward, x, dropout_ratio=0.5)

@@ -1,0 +1,149 @@
+"""RBM / autoencoder / Kohonen pretraining gates — parity config #4."""
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.launcher import Launcher
+from veles_tpu.znicz.samples.mnist_rbm import (MnistRBMWorkflow,
+                                               MnistAEWorkflow)
+
+
+def test_rbm_cd_gradient_matches_statistics():
+    """The autodiff of the free-energy difference must equal the
+    CD-1 statistics v0ᵀh0 − v1ᵀh1 (the defining property of the
+    pseudo-loss trick)."""
+    import jax
+    import jax.numpy as jnp
+    rng = numpy.random.RandomState(0)
+    v0 = (rng.rand(16, 20) > 0.5).astype(numpy.float32)
+    w = rng.normal(0, 0.1, (20, 8)).astype(numpy.float32)
+    b = numpy.zeros(20, numpy.float32)
+    c = numpy.zeros(8, numpy.float32)
+    key = jax.random.PRNGKey(3)
+
+    def chain(w, b, c):
+        h0 = jax.nn.sigmoid(v0 @ w + c)
+        hs = jax.random.bernoulli(key, h0).astype(jnp.float32)
+        v1 = jax.nn.sigmoid(hs @ w.T + b)
+        h1 = jax.nn.sigmoid(v1 @ w + c)
+        return h0, jax.lax.stop_gradient(v1), h1
+
+    def fe(v, w, b, c):
+        return -(v @ b) - jax.nn.softplus(c + v @ w).sum(-1)
+
+    def loss(w, b, c):
+        h0, v1, h1 = chain(w, b, c)
+        return (fe(v0, w, b, c) - fe(v1, w, b, c)).mean()
+
+    gw = jax.grad(loss, argnums=0)(w, b, c)
+    h0, v1, h1 = chain(w, b, c)
+    want = -(v0.T @ h0 - numpy.asarray(v1).T @ h1) / 16.0
+    numpy.testing.assert_allclose(numpy.asarray(gw), want, rtol=1e-4,
+                                  atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def rbm_trained():
+    prng.reset()
+    prng.get(0).seed(9)
+    launcher = Launcher()
+    wf = MnistRBMWorkflow(launcher, n_hidden=64, max_epochs=4,
+                          learning_rate=0.1)
+    launcher.initialize()
+    launcher.run()
+    return wf
+
+
+def test_rbm_reconstruction_improves(rbm_trained):
+    d = rbm_trained.decision
+    from veles_tpu.loader.base import VALID
+    # epoch_loss holds per-tick mean summed-SE; divide by 784 pixels
+    # for a per-pixel feel — just require a meaningful drop vs the
+    # random-init reconstruction (~0.25/pixel for sigmoid outputs).
+    final = d.epoch_loss[VALID] / 100.0 / 784.0
+    assert final < 0.08, final
+
+
+def test_ae_tied_weights_train():
+    prng.reset()
+    prng.get(0).seed(10)
+    launcher = Launcher()
+    wf = MnistAEWorkflow(launcher, n_hidden=64, max_epochs=4)
+    launcher.initialize()
+    w0 = numpy.array(wf.encoder.weights.mem)
+    launcher.run()
+    wf.encoder.weights.map_read()
+    w1 = numpy.array(wf.encoder.weights.mem)
+    # Tied decoder gradients must reach the encoder weights.
+    assert numpy.abs(w1 - w0).max() > 1e-3
+    from veles_tpu.loader.base import VALID
+    per_px = wf.decision.epoch_loss[VALID] / 100.0 / 784.0
+    assert per_px < 0.05, per_px
+
+
+def test_kohonen_som_organizes():
+    import jax
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.kohonen import (KohonenForward,
+                                         KohonenTrainer, GDKohonen)
+    from veles_tpu.accelerated_units import (AcceleratedWorkflow,
+                                             StepCompiler)
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.plumbing import Repeater
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.znicz.decision import DecisionBase
+
+    class BlobLoader(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            centers = rng.rand(4, 2).astype(numpy.float32)
+            pts = numpy.concatenate([
+                c + rng.normal(0, 0.02, (100, 2)) for c in centers])
+            self.original_data.mem = pts.astype(numpy.float32)
+            self.class_lengths = [0, 0, 400]
+
+    prng.reset()
+    prng.get(0).seed(5)
+    launcher = Launcher()
+
+    class SOMWorkflow(AcceleratedWorkflow):
+        def __init__(self, workflow, **kwargs):
+            super(SOMWorkflow, self).__init__(workflow, **kwargs)
+            self.repeater = Repeater(self)
+            self.repeater.link_from(self.start_point)
+            self.loader = BlobLoader(self, minibatch_size=50)
+            self.loader.link_from(self.repeater)
+            self.som = KohonenForward(self, shape=(4, 4),
+                                      weights_stddev=0.3)
+            self.som.link_from(self.loader)
+            self.som.input = self.loader.minibatch_data
+            self.trainer = KohonenTrainer(self, forward=self.som,
+                                          sigma_decay=0.93)
+            self.trainer.link_from(self.som)
+            self.trainer.input = self.loader.minibatch_data
+            self.decision = DecisionBase(self, max_epochs=12)
+            self.decision.link_from(self.trainer)
+            self.decision.link_attrs(
+                self.loader, "minibatch_class", "last_minibatch",
+                "epoch_ended", "epoch_number")
+            self.gd = GDKohonen(self, target=self.som,
+                                learning_rate=0.4)
+            self.gd.link_from(self.decision)
+            self.repeater.link_from(self.gd)
+            self.repeater.gate_block = self.decision.complete
+            self.end_point.link_from(self.gd)
+            self.end_point.gate_block = ~self.decision.complete
+
+    wf = SOMWorkflow(launcher)
+    launcher.initialize()
+    launcher.run()
+    # After training, the SOM prototypes must cover the 4 blobs:
+    # every blob center has a prototype within 0.15.
+    wf.som.weights.map_read()
+    w = wf.som.weights.mem
+    rng = numpy.random.RandomState(0)
+    centers = rng.rand(4, 2).astype(numpy.float32)
+    for c in centers:
+        assert numpy.sqrt(((w - c) ** 2).sum(1)).min() < 0.15

@@ -38,4 +38,9 @@ from .gd import (GradientDescent, GDTanh, GDRelu,  # noqa: F401
                  GDActivationSigmoid, GDActivationLog,
                  GDActivationTanhLog, GDActivationSinCos,
                  GDActivationMul, GDDropout, GDLRNormalizer)
+from .rbm import (RBM, GDRBM, EvaluatorRBM, All2AllDeconv,  # noqa: F401
+                  All2AllDeconvSigmoid, All2AllDeconvTanh)
+from .kohonen import (KohonenForward, KohonenTrainer,  # noqa: F401
+                      GDKohonen)
 from .decision import DecisionBase, DecisionGD  # noqa: F401
+from .standard_workflow import StandardWorkflow  # noqa: F401

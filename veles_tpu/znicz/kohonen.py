@@ -1,0 +1,148 @@
+"""Kohonen self-organizing map units.
+
+Reconstructed znicz capability surface (SURVEY §2.5: "KohonenForward
+etc." — znicz shipped a Kohonen forward/trainer pair with a decaying
+Gaussian neighborhood on a 2-D grid).
+
+TPU-era mapping: the SOM update  Δw_i = lr·h_σ(winner,i)·(x − w_i)
+is the negative gradient of the pseudo-loss
+
+    L = ½ Σ_batch Σ_i h_σ(winner, i) · ‖x − w_i‖²
+
+with the winner assignment and neighborhood h treated as constants
+(``stop_gradient``), so — like the RBM's CD — the trainer just sets L
+as the step loss and the standard GD unit applies the update inside
+the fused jit.  The neighborhood radius σ decays with the trained-tick
+counter kept in device-side state.
+"""
+
+import numpy
+
+from ..memory import Vector
+from .nn_units import ForwardBase, GradientDescentBase
+
+
+class KohonenForward(ForwardBase):
+    """Winner-take-all forward: emits the BMU index per sample
+    (znicz ``KohonenForward``)."""
+
+    MAPPING = "kohonen"
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenForward, self).__init__(workflow, **kwargs)
+        # SOM grid shape (y, x) — znicz used 2-D maps.
+        self.shape = tuple(kwargs.get("shape", (8, 8)))
+        self.include_bias = False
+        self.winners = Vector()
+
+    @property
+    def n_neurons(self):
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+    @property
+    def trainables(self):
+        return {"weights": self.weights}
+
+    def initialize(self, device=None, **kwargs):
+        super(KohonenForward, self).initialize(device=device, **kwargs)
+        batch = self.input.shape[0]
+        n_in = self.input.size // batch
+        if not self.weights:
+            stddev = self.weights_stddev or (1.0 / numpy.sqrt(n_in))
+            w = numpy.zeros((self.n_neurons, n_in),
+                            dtype=numpy.float32)
+            self.rand().fill_normal(w, stddev=stddev)
+            self.weights.mem = w
+            self.weights.initialize(self.device)
+        self.output.mem = numpy.zeros((batch, self.n_neurons),
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+        self.winners.mem = numpy.zeros(batch, dtype=numpy.int32)
+        self.winners.initialize(self.device)
+
+    def step_persist_vectors(self):
+        return [self.output, self.winners]
+
+    def distances(self, x, w):
+        import jax.numpy as jnp
+        # ‖x−w‖² expanded: the x·wᵀ matmul rides the MXU.
+        return ((x * x).sum(1, keepdims=True) - 2.0 * (x @ w.T) +
+                (w * w).sum(1))
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        x = read(self.input)
+        x = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        d = self.distances(x, params["weights"])
+        write(self.output, d)
+        write(self.winners, jnp.argmin(d, axis=1).astype(jnp.int32))
+
+
+class KohonenTrainer(ForwardBase):
+    """Sets the SOM pseudo-loss whose gradient is the Kohonen update
+    (znicz ``KohonenTrainer``).  ``target`` is the paired
+    KohonenForward; σ decays exponentially from ``sigma0`` to
+    ``sigma_min`` with trained ticks."""
+
+    MAPPING = "kohonen_trainer"
+    HAS_PARAMS = False
+
+    def __init__(self, workflow, **kwargs):
+        super(KohonenTrainer, self).__init__(workflow, **kwargs)
+        self.forward = kwargs["forward"]
+        self.sigma0 = kwargs.get("sigma0",
+                                 max(self.forward.shape) / 2.0)
+        self.sigma_min = kwargs.get("sigma_min", 0.5)
+        self.sigma_decay = kwargs.get("sigma_decay", 0.999)
+        self.ticks = Vector(numpy.zeros((), dtype=numpy.float32))
+        self._grid = None
+
+    @property
+    def trainables(self):
+        return {}
+
+    @property
+    def tstate(self):
+        return {"ticks": self.ticks}
+
+    def initialize(self, device=None, **kwargs):
+        super(KohonenTrainer, self).initialize(device=device, **kwargs)
+        gy, gx = self.forward.shape
+        yy, xx = numpy.mgrid[0:gy, 0:gx]
+        self._grid = numpy.stack(
+            [yy.ravel(), xx.ravel()]).T.astype(numpy.float32)
+        self.output.mem = numpy.zeros((), dtype=numpy.float32)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax
+        import jax.numpy as jnp
+        x = read(self.input)
+        x = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        w = read(self.forward.weights)   # param tracer via the bag
+        d = self.forward.distances(x, jax.lax.stop_gradient(w))
+        winners = jnp.argmin(d, axis=1)
+        grid = jnp.asarray(self._grid)
+        t = state["ticks"] if state is not None else 0.0
+        sigma = jnp.maximum(self.sigma0 * self.sigma_decay ** t,
+                            self.sigma_min)
+        # Gaussian neighborhood of each sample's winner (constant wrt
+        # the differentiated params).
+        gd2 = ((grid[winners][:, None, :] - grid[None, :, :]) ** 2
+               ).sum(-1)
+        h = jax.lax.stop_gradient(jnp.exp(-gd2 / (2.0 * sigma ** 2)))
+        # ½·Σ h·‖x−w‖² via the MXU-friendly expansion (no (B,N,D)
+        # tensor materialized; ∂/∂w gives the Kohonen update).
+        loss = 0.5 * (h * self.forward.distances(x, w)).sum() / \
+            x.shape[0]
+        ctx.set_loss(loss)
+        ctx.add_metric("som_quant_err", jnp.sqrt(
+            jnp.take_along_axis(d, winners[:, None], 1).mean()))
+        if state is not None:
+            return {"ticks": t + 1.0}
+
+
+class GDKohonen(GradientDescentBase):
+    MAPPING = "kohonen"

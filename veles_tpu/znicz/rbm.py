@@ -1,0 +1,230 @@
+"""RBM and tied-weight autoencoder pretraining units.
+
+Reconstructed znicz capability surface (SURVEY §2.5 / BASELINE.json
+parity config #4: "RBM/autoencoder pretraining with tied-weight deconv
+units"; the reference's GPU RNG kernel ocl/random.cl existed largely to
+drive the RBM's Bernoulli sampling).
+
+TPU-era mapping of contrastive divergence: CD-k is NOT plain gradient
+descent, but its update rule IS the gradient of the free-energy
+difference
+
+    L = FE(v0) − FE(vk),   FE(v) = −v·b − Σ softplus(c + vW)
+
+with the negative phase ``vk`` treated as a constant
+(``stop_gradient``).  So the :class:`RBM` unit computes the Gibbs
+chain with the step's keyed PRNG and sets L as the step loss — the
+fused-step compiler's ``jax.grad`` then yields exactly the CD-k
+statistics ⟨v0ᵀh0⟩−⟨vkᵀhk⟩, and the ordinary per-layer GD units
+(momentum, weight decay) apply them.  One jitted computation per tick,
+no hand-written CD kernels.
+
+:class:`All2AllDeconv` is the tied-weight decoder half for denoising-
+autoencoder pretraining: y = act(x·Wᵀ + b) with W read from (and
+trained through) the paired encoder.
+"""
+
+import numpy
+
+from ..memory import Vector
+from . import nn_units
+from .evaluator import EvaluatorBase
+from .nn_units import ForwardBase, GradientDescentBase
+
+
+class RBM(ForwardBase):
+    """Bernoulli-Bernoulli RBM layer trained by CD-k
+    (znicz RBM unit family).
+
+    Outputs: ``output`` — hidden probabilities h0 (the features for
+    stacking); ``reconstruction`` — vk probabilities (for evaluators).
+    """
+
+    MAPPING = "rbm"
+
+    def __init__(self, workflow, **kwargs):
+        super(RBM, self).__init__(workflow, **kwargs)
+        self.output_sample_shape = kwargs.get("output_sample_shape",
+                                              kwargs.get("output_shape"))
+        if isinstance(self.output_sample_shape, int):
+            self.output_sample_shape = (self.output_sample_shape,)
+        self.cd_k = kwargs.get("cd_k", 1)
+        self.vbias = Vector()  # visible bias (b)
+        self.reconstruction = Vector()
+
+    @property
+    def n_hidden(self):
+        n = 1
+        for d in self.output_sample_shape:
+            n *= d
+        return n
+
+    @property
+    def trainables(self):
+        t = {"weights": self.weights, "vbias": self.vbias}
+        if self.include_bias:
+            t["bias"] = self.bias  # hidden bias (c)
+        return t
+
+    def initialize(self, device=None, **kwargs):
+        super(RBM, self).initialize(device=device, **kwargs)
+        batch = self.input.shape[0]
+        n_vis = self.input.size // batch
+        n_hid = self.n_hidden
+        if not self.weights:
+            stddev = self.weights_stddev or (1.0 / numpy.sqrt(n_vis))
+            w = numpy.zeros((n_vis, n_hid), dtype=numpy.float32)
+            self.rand().fill_normal(w, stddev=stddev)
+            self.weights.mem = w
+            self.weights.initialize(self.device)
+        if self.include_bias and not self.bias:
+            self.bias.mem = numpy.zeros(n_hid, dtype=numpy.float32)
+            self.bias.initialize(self.device)
+        if not self.vbias:
+            self.vbias.mem = numpy.zeros(n_vis, dtype=numpy.float32)
+            self.vbias.initialize(self.device)
+        self.output.mem = numpy.zeros((batch,) +
+                                      tuple(self.output_sample_shape),
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+        self.reconstruction.mem = numpy.zeros(
+            (batch, n_vis), dtype=numpy.float32)
+        self.reconstruction.initialize(self.device)
+
+    def step_persist_vectors(self):
+        return [self.output, self.reconstruction]
+
+    def _free_energy(self, v, w, b, c):
+        import jax
+        import jax.numpy as jnp
+        return -(v @ b) - jax.nn.softplus(c + v @ w).sum(axis=-1)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax
+        import jax.numpy as jnp
+        v0 = read(self.input)
+        v0 = v0.reshape(v0.shape[0], -1).astype(jnp.float32)
+        w = params["weights"]
+        b = params["vbias"]
+        c = params["bias"] if self.include_bias else 0.0
+
+        h = jax.nn.sigmoid(v0 @ w + c)
+        write(self.output,
+              h.reshape((v0.shape[0],) +
+                        tuple(self.output_sample_shape)))
+        vk = v0
+        hk = h
+        for _ in range(self.cd_k):
+            hs = jax.random.bernoulli(
+                ctx.next_key(), hk).astype(jnp.float32)
+            vk = jax.nn.sigmoid(hs @ w.T + b)
+            hk = jax.nn.sigmoid(vk @ w + c)
+        vk = jax.lax.stop_gradient(vk)
+        write(self.reconstruction, vk)
+        # CD-k pseudo-loss: grad == positive − negative statistics.
+        loss = (self._free_energy(v0, w, b, c) -
+                self._free_energy(vk, w, b, c)).mean()
+        ctx.set_loss(loss)
+
+
+class GDRBM(GradientDescentBase):
+    """Momentum/decay applier for the CD statistics."""
+    MAPPING = "rbm"
+
+
+class EvaluatorRBM(EvaluatorBase):
+    """Reconstruction-MSE metrics for RBM pretraining.  Does NOT set
+    the step loss — the RBM's CD pseudo-loss is the differentiated
+    objective; this unit only feeds Decision's epoch accounting."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorRBM, self).__init__(workflow, **kwargs)
+        self.target = None  # linked: loader minibatch data
+        self.demand("target", "mask", "minibatch_class_vec")
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        recon = read(self.input).astype(jnp.float32)
+        t = read(self.target)
+        t = t.reshape(t.shape[0], -1).astype(jnp.float32)
+        mask = read(self.mask)
+        n_valid = jnp.maximum(mask.sum(), 1.0)
+        se = ((recon - t) ** 2).sum(axis=1)
+        mse = (se * mask).sum() / n_valid
+        ctx.add_metric("rmse", jnp.sqrt(mse))
+        ctx.add_metric("n_valid", mask.sum())
+        # err column carries the summed SE → Decision reports epoch
+        # MSE through the same accumulator as classification error.
+        return self._accumulate(read, state, (se * mask).sum(),
+                                mask.sum(), mse)
+
+
+class All2AllDeconv(ForwardBase):
+    """Tied-weight dense decoder: y = act(x·Wᵀ + b) with W shared
+    from the paired encoder All2All (znicz tied-weight deconv for
+    autoencoder pretraining).  Own trainable: the visible bias."""
+
+    MAPPING = "all2all_deconv"
+
+    def __init__(self, workflow, **kwargs):
+        super(All2AllDeconv, self).__init__(workflow, **kwargs)
+        self.encoder = kwargs["get_weights_from"]
+        self.vbias = Vector()
+
+    @property
+    def trainables(self):
+        return {"vbias": self.vbias} if self.include_bias else {}
+
+    def activation(self, v):
+        return v
+
+    def initialize(self, device=None, **kwargs):
+        if not self.encoder.is_initialized:
+            raise AttributeError(
+                "%s: tied encoder %s not initialized yet" %
+                (self.name, self.encoder.name))
+        super(All2AllDeconv, self).initialize(device=device, **kwargs)
+        batch = self.input.shape[0]
+        n_vis = self.encoder.weights.shape[0]
+        if not self.vbias:
+            self.vbias.mem = numpy.zeros(n_vis, dtype=numpy.float32)
+            self.vbias.initialize(self.device)
+        self.output.mem = numpy.zeros((batch, n_vis),
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        x = read(self.input)
+        x = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        w = read(self.encoder.weights)  # tied: grads flow to encoder
+        y = x @ w.T
+        if self.include_bias:
+            y = y + params["vbias"]
+        write(self.output, self.activation(y))
+
+
+class All2AllDeconvSigmoid(All2AllDeconv):
+    MAPPING = "all2all_deconv_sigmoid"
+
+    def activation(self, v):
+        return nn_units.act_sigmoid(v)
+
+
+class All2AllDeconvTanh(All2AllDeconv):
+    MAPPING = "all2all_deconv_tanh"
+
+    def activation(self, v):
+        return nn_units.act_tanh(v)
+
+
+class GDA2ADeconv(GradientDescentBase):
+    MAPPING = "all2all_deconv"
+
+
+class GDA2ADeconvSigmoid(GradientDescentBase):
+    MAPPING = "all2all_deconv_sigmoid"
+
+
+class GDA2ADeconvTanh(GradientDescentBase):
+    MAPPING = "all2all_deconv_tanh"

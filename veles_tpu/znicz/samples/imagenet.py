@@ -102,12 +102,25 @@ class ImagenetLoader(FullBatchLoader):
     def _analyze_mean_disp(self):
         """Train-set per-pixel mean and reciprocal dispersion
         (the reference loader's dataset analysis feeding
-        mean_disp_normalizer)."""
-        train_start = self.class_lengths[0] + self.class_lengths[1]
+        mean_disp_normalizer).  Two-pass chunked accumulation: the
+        uint8 originals are never copied to float wholesale, so the
+        real-ImageNet geometry (hundreds of GB) stays O(sample_shape)
+        in extra host memory."""
+        from ...loader.base import VALID
+        train_start = self.class_end_offsets[VALID]
         train = self.original_data.mem[train_start:]
-        mean = train.mean(axis=0).astype(numpy.float32)
-        disp = train.astype(numpy.float32).std(axis=0)
-        self.mean.mem = mean
+        n = len(train)
+        s = numpy.zeros(train.shape[1:], dtype=numpy.float64)
+        s2 = numpy.zeros(train.shape[1:], dtype=numpy.float64)
+        chunk = max(1, (1 << 28) // max(
+            1, int(numpy.prod(train.shape[1:])) * 8))
+        for i in range(0, n, chunk):
+            part = train[i:i + chunk].astype(numpy.float64)
+            s += part.sum(axis=0)
+            s2 += (part * part).sum(axis=0)
+        mean = s / n
+        disp = numpy.sqrt(numpy.maximum(s2 / n - mean * mean, 0.0))
+        self.mean.mem = mean.astype(numpy.float32)
         self.rdisp.mem = (1.0 / numpy.maximum(disp, 1e-3)).astype(
             numpy.float32)
 
@@ -176,7 +189,7 @@ class AlexNetWorkflow(StandardWorkflow):
                              "fail_iterations": fail_iterations},
             loss_function="softmax", **kwargs)
 
-    def link_forwards(self):
+    def first_source(self):
         """Inserts the mean-disp normalizer between the loader's byte
         gather and conv1 (the reference AlexNet pipeline shape)."""
         self.normalizer = MeanDispNormalizer(self)
@@ -184,21 +197,7 @@ class AlexNetWorkflow(StandardWorkflow):
         self.normalizer.input = self.loader.minibatch_data
         self.normalizer.mean = self.loader.mean
         self.normalizer.rdisp = self.loader.rdisp
-
-        prev, prev_vec = self.normalizer, self.normalizer.output
-        from ..nn_units import ForwardUnitRegistry
-        for i, cfg in enumerate(self.layer_configs):
-            cfg = dict(cfg)
-            type_name = cfg.pop("type")
-            fwd_kwargs = dict(cfg.get("->", {}))
-            cls = ForwardUnitRegistry.get_factory(type_name)
-            unit = cls(self, name="%s%d" % (type_name, i),
-                       **fwd_kwargs)
-            unit.link_from(prev)
-            unit.input = prev_vec
-            self.forwards.append(unit)
-            prev, prev_vec = unit, unit.output
-        return self.forwards
+        return self.normalizer, self.normalizer.output
 
 
 def run(load, main):

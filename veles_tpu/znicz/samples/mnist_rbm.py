@@ -1,0 +1,119 @@
+"""Unsupervised pretraining workflows — parity config #4
+(BASELINE.json: "RBM/autoencoder pretraining").
+
+Two graphs over the MNIST784 loader:
+
+  * :class:`MnistRBMWorkflow` — Bernoulli RBM trained by CD-k (the
+    CD statistics come from autodiff of the free-energy difference,
+    rbm.py); Decision tracks reconstruction MSE per epoch.
+  * :class:`MnistAEWorkflow` — tied-weight denoising autoencoder:
+    All2AllSigmoid encoder + All2AllDeconvSigmoid decoder sharing the
+    encoder's weights, MSE against the clean input.
+
+Both produce pretrained weights a supervised workflow can adopt by
+Vector assignment (znicz's pretraining → fine-tune flow).
+"""
+
+from ...accelerated_units import AcceleratedWorkflow
+from ...plumbing import Repeater
+from ..decision import DecisionGD
+from ..evaluator import EvaluatorMSE
+from ..gd import GDSigmoid
+from ..rbm import (RBM, GDRBM, EvaluatorRBM, All2AllDeconvSigmoid,
+                   GDA2ADeconvSigmoid)
+from ..all2all import All2AllSigmoid
+from .mnist import MnistLoader
+
+
+class MnistRBMWorkflow(AcceleratedWorkflow):
+    def __init__(self, workflow, n_hidden=128, minibatch_size=100,
+                 learning_rate=0.05, gradient_moment=0.5, cd_k=1,
+                 max_epochs=5, loader_cls=MnistLoader, **kwargs):
+        super(MnistRBMWorkflow, self).__init__(workflow, **kwargs)
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+
+        self.loader = loader_cls(self, minibatch_size=minibatch_size)
+        self.loader.link_from(self.repeater)
+
+        self.rbm = RBM(self, output_sample_shape=(n_hidden,),
+                       cd_k=cd_k, weights_stddev=0.01)
+        self.rbm.link_from(self.loader)
+        self.rbm.input = self.loader.minibatch_data
+
+        self.evaluator = EvaluatorRBM(self)
+        self.evaluator.link_from(self.rbm)
+        self.evaluator.input = self.rbm.reconstruction
+        self.evaluator.target = self.loader.minibatch_data
+        self.evaluator.mask = self.loader.minibatch_mask
+        self.evaluator.minibatch_class_vec = \
+            self.loader.minibatch_class_vec
+
+        self.decision = DecisionGD(self, max_epochs=max_epochs,
+                                   evaluator=self.evaluator)
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(
+            self.loader, "minibatch_class", "last_minibatch",
+            "epoch_ended", "epoch_number")
+
+        self.gd = GDRBM(self, target=self.rbm,
+                        learning_rate=learning_rate,
+                        gradient_moment=gradient_moment)
+        self.gd.link_from(self.decision)
+
+        self.repeater.link_from(self.gd)
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(self.gd)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+class MnistAEWorkflow(AcceleratedWorkflow):
+    def __init__(self, workflow, n_hidden=128, minibatch_size=100,
+                 learning_rate=0.1, gradient_moment=0.9,
+                 max_epochs=5, loader_cls=MnistLoader, **kwargs):
+        super(MnistAEWorkflow, self).__init__(workflow, **kwargs)
+        self.repeater = Repeater(self)
+        self.repeater.link_from(self.start_point)
+
+        self.loader = loader_cls(self, minibatch_size=minibatch_size)
+        self.loader.link_from(self.repeater)
+
+        self.encoder = All2AllSigmoid(
+            self, output_sample_shape=(n_hidden,),
+            weights_stddev=0.05, name="encoder")
+        self.encoder.link_from(self.loader)
+        self.encoder.input = self.loader.minibatch_data
+
+        self.decoder = All2AllDeconvSigmoid(
+            self, get_weights_from=self.encoder, name="decoder")
+        self.decoder.link_from(self.encoder)
+        self.decoder.input = self.encoder.output
+
+        self.evaluator = EvaluatorMSE(self, root=True)
+        self.evaluator.link_from(self.decoder)
+        self.evaluator.input = self.decoder.output
+        self.evaluator.target = self.loader.minibatch_data
+        self.evaluator.mask = self.loader.minibatch_mask
+        self.evaluator.minibatch_class_vec = \
+            self.loader.minibatch_class_vec
+
+        self.decision = DecisionGD(self, max_epochs=max_epochs,
+                                   evaluator=self.evaluator)
+        self.decision.link_from(self.evaluator)
+        self.decision.link_attrs(
+            self.loader, "minibatch_class", "last_minibatch",
+            "epoch_ended", "epoch_number")
+
+        gd_kw = {"learning_rate": learning_rate,
+                 "gradient_moment": gradient_moment}
+        self.gd_decoder = GDA2ADeconvSigmoid(
+            self, target=self.decoder, **gd_kw)
+        self.gd_decoder.link_from(self.decision)
+        self.gd_encoder = GDSigmoid(
+            self, target=self.encoder, **gd_kw)
+        self.gd_encoder.link_from(self.gd_decoder)
+
+        self.repeater.link_from(self.gd_encoder)
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(self.gd_encoder)
+        self.end_point.gate_block = ~self.decision.complete

@@ -60,8 +60,14 @@ class StandardWorkflow(AcceleratedWorkflow):
 
     # -- builders (overridable, znicz ergonomics) --------------------------
 
+    def first_source(self):
+        """(unit, vector) feeding the first layer — overridable for
+        pipelines inserting preprocessing units (e.g. AlexNet's
+        mean-disp normalizer)."""
+        return self.loader, self.loader.minibatch_data
+
     def link_forwards(self):
-        prev, prev_vec = self.loader, self.loader.minibatch_data
+        prev, prev_vec = self.first_source()
         for i, cfg in enumerate(self.layer_configs):
             cfg = dict(cfg)
             type_name = cfg.pop("type")
