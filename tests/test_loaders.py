@@ -1,0 +1,274 @@
+"""Loader family + normalization registry tests (reference analogue:
+veles/tests/test_normalization.py and the loader tests)."""
+
+import os
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.normalization import (NormalizerRegistry,
+                                     normalizer_factory)
+
+
+# -- normalizers -----------------------------------------------------------
+
+def test_registry_has_reference_mappings():
+    for name in ("none", "linear", "range_linear", "mean_disp",
+                 "external_mean", "pointwise"):
+        assert name in NormalizerRegistry.registry
+
+
+def test_linear_normalizer_roundtrip():
+    n = normalizer_factory("linear")
+    data = numpy.array([[0.0, 5.0], [10.0, 2.5]])
+    out = n.normalize(data)
+    assert out.min() == -1.0 and out.max() == 1.0
+    numpy.testing.assert_allclose(n.denormalize(out), data, rtol=1e-6)
+
+
+def test_linear_streaming_analyze():
+    n = normalizer_factory("linear")
+    n.analyze(numpy.array([0.0, 1.0]))
+    n.analyze(numpy.array([4.0, 2.0]))
+    out = n.normalize(numpy.array([2.0]))
+    numpy.testing.assert_allclose(out, [0.0], atol=1e-7)
+
+
+def test_range_linear_bytes():
+    n = normalizer_factory("range_linear", interval=(0, 255),
+                           target=(-1, 1))
+    out = n.normalize(numpy.array([0.0, 127.5, 255.0]))
+    numpy.testing.assert_allclose(out, [-1.0, 0.0, 1.0], atol=1e-6)
+    numpy.testing.assert_allclose(
+        n.denormalize(out), [0.0, 127.5, 255.0], atol=1e-4)
+
+
+def test_mean_disp_normalizer_stats():
+    rng = numpy.random.RandomState(0)
+    data = rng.normal(3.0, 2.0, (500, 4)).astype(numpy.float32)
+    n = normalizer_factory("mean_disp")
+    n.analyze(data[:250])
+    n.analyze(data[250:])  # streaming slabs
+    out = n.normalize(data)
+    assert abs(out.mean()) < 0.05
+    assert abs(out.std() - 1.0) < 0.05
+    numpy.testing.assert_allclose(n.denormalize(out), data, rtol=1e-3,
+                                  atol=1e-3)
+
+
+def test_pointwise_normalizer():
+    data = numpy.array([[0.0, 10.0], [2.0, 30.0]])
+    n = normalizer_factory("pointwise")
+    out = n.normalize(data)
+    numpy.testing.assert_allclose(out, [[-1, -1], [1, 1]], atol=1e-6)
+
+
+def test_normalizer_state_pickles():
+    n = normalizer_factory("mean_disp")
+    n.analyze(numpy.ones((10, 3)))
+    n2 = pickle.loads(pickle.dumps(n))
+    numpy.testing.assert_allclose(n2.normalize(numpy.ones((2, 3))),
+                                  n.normalize(numpy.ones((2, 3))))
+
+
+# -- image loader ----------------------------------------------------------
+
+@pytest.fixture
+def image_tree(tmp_path):
+    from PIL import Image
+    rng = numpy.random.RandomState(0)
+    for cls_name in ("cats", "dogs"):
+        d = tmp_path / "train" / cls_name
+        d.mkdir(parents=True)
+        for i in range(4):
+            arr = rng.randint(0, 255, (20, 24, 3)).astype("uint8")
+            Image.fromarray(arr).save(d / ("img%d.png" % i))
+    return tmp_path
+
+
+def test_file_image_loader(image_tree):
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+    wf = DummyWorkflow()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[str(image_tree / "train")],
+        size=(16, 16), minibatch_size=4,
+        normalization_type="range_linear")
+    loader.initialize()
+    assert loader.class_lengths == [0, 0, 8]
+    assert loader.original_data.shape == (8, 16, 16, 3)
+    assert set(loader.original_labels.mem) == {0, 1}
+    assert loader.original_data.mem.min() >= -1.0
+    assert loader.original_data.mem.max() <= 1.0
+
+
+def test_image_loader_mirror(image_tree):
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+    wf = DummyWorkflow()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[str(image_tree / "train")],
+        size=(16, 16), minibatch_size=4, mirror=True)
+    loader.initialize()
+    assert loader.class_lengths == [0, 0, 16]
+
+
+# -- pickles / hdf5 --------------------------------------------------------
+
+def test_pickles_loader(tmp_path):
+    from veles_tpu.loader.pickles import PicklesLoader
+    rng = numpy.random.RandomState(0)
+    train = (rng.rand(20, 6).astype(numpy.float32),
+             rng.randint(0, 3, 20))
+    valid = {"data": rng.rand(8, 6).astype(numpy.float32),
+             "labels": rng.randint(0, 3, 8)}
+    tp, vp = tmp_path / "train.pickle", tmp_path / "valid.pickle"
+    with open(tp, "wb") as f:
+        pickle.dump(train, f)
+    with open(vp, "wb") as f:
+        pickle.dump(valid, f)
+    wf = DummyWorkflow()
+    loader = PicklesLoader(wf, train_path=str(tp),
+                           validation_path=str(vp), minibatch_size=5)
+    loader.initialize()
+    assert loader.class_lengths == [0, 8, 20]
+    assert loader.original_data.shape == (28, 6)
+    numpy.testing.assert_array_equal(
+        loader.original_data.mem[:8], valid["data"])
+
+
+def test_hdf5_loader(tmp_path):
+    import h5py
+    from veles_tpu.loader.hdf5 import HDF5Loader
+    rng = numpy.random.RandomState(0)
+    path = tmp_path / "train.h5"
+    with h5py.File(path, "w") as f:
+        f["data"] = rng.rand(12, 5).astype(numpy.float32)
+        f["labels"] = rng.randint(0, 2, 12)
+    wf = DummyWorkflow()
+    loader = HDF5Loader(wf, train_path=str(path), minibatch_size=4)
+    loader.initialize()
+    assert loader.class_lengths == [0, 0, 12]
+    assert loader.original_labels.mem.dtype == numpy.int32
+
+
+# -- minibatch saver/replay ------------------------------------------------
+
+def test_minibatch_saver_roundtrip(tmp_path):
+    from veles_tpu.loader.saver import (MinibatchesSaver,
+                                        MinibatchesLoader)
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+
+    class TinyLoader(FullBatchLoader):
+        def load_data(self):
+            self.original_data.mem = numpy.arange(
+                30, dtype=numpy.float32).reshape(10, 3)
+            self.original_labels.mem = numpy.arange(
+                10, dtype=numpy.int32)
+            self.class_lengths = [0, 4, 6]
+
+        def fill_minibatch(self):
+            # Padded indices: fixed-size minibatch like the real
+            # device-side gather (invalid rows masked out).
+            idx = self.minibatch_indices.mem
+            self.minibatch_data.mem = numpy.take(
+                self.original_data.mem, idx, axis=0)
+            self.minibatch_labels.mem = numpy.take(
+                self.original_labels.mem, idx, axis=0)
+
+    dump = str(tmp_path / "mb.dmp.gz")
+    wf = DummyWorkflow()
+    loader = TinyLoader(wf, minibatch_size=4)
+    loader.initialize()
+    saver = MinibatchesSaver(wf, file_name=dump)
+    saver.link_attrs(loader, "minibatch_data", "minibatch_labels",
+                     "minibatch_mask", "minibatch_class")
+    saver.initialize()
+    for _ in range(3):  # one full epoch: 4 valid + 6 train rows
+        loader.serve_next_minibatch()
+        loader.fill_minibatch()
+        saver.run()
+    saver.stop()
+
+    wf2 = DummyWorkflow()
+    replay = MinibatchesLoader(wf2, file_name=dump, minibatch_size=4)
+    replay.initialize()
+    assert replay.class_lengths[1] == 4
+    assert replay.class_lengths[2] == 6
+    assert replay.original_data.shape == (10, 3)
+
+
+# -- queue loader ----------------------------------------------------------
+
+def test_queue_loader_serves_fed_samples():
+    from veles_tpu.loader.interactive import QueueLoader
+    wf = DummyWorkflow()
+    loader = QueueLoader(wf, sample_shape=(3,), minibatch_size=4)
+    loader.initialize()
+    loader.feed([1.0, 2.0, 3.0], context="a")
+    loader.feed([4.0, 5.0, 6.0], context="b")
+    loader.serve_next_minibatch()
+    loader.fill_minibatch()
+    assert loader.minibatch_size == 2
+    numpy.testing.assert_array_equal(
+        loader.minibatch_data.mem[0], [1, 2, 3])
+    assert loader.minibatch_contexts[:2] == ["a", "b"]
+
+
+# -- input joiner / avatar / downloader ------------------------------------
+
+def test_input_joiner():
+    from veles_tpu.input_joiner import InputJoiner
+    from veles_tpu.memory import Vector
+    wf = DummyWorkflow()
+    a = Vector(numpy.ones((4, 2), dtype=numpy.float32))
+    b = Vector(numpy.full((4, 3, 2), 2.0, dtype=numpy.float32))
+    joiner = InputJoiner(wf, inputs=[a, b])
+    joiner.initialize()
+    assert joiner.output.shape == (4, 8)
+    assert (joiner.offset_0, joiner.length_0) == (0, 2)
+    assert (joiner.offset_1, joiner.length_1) == (2, 6)
+    joiner.eager_run()
+    joiner.output.map_read()
+    numpy.testing.assert_array_equal(
+        joiner.output.mem[0], [1, 1, 2, 2, 2, 2, 2, 2])
+
+
+def test_avatar_clones_and_isolates():
+    from veles_tpu.avatar import Avatar
+    from veles_tpu.memory import Vector
+    from veles_tpu.units import TrivialUnit
+    wf = DummyWorkflow()
+    src = TrivialUnit(wf)
+    src.payload = Vector(numpy.zeros(3, dtype=numpy.float32))
+    src.scalar = 7
+    av = Avatar(wf, source=src, attrs=["payload", "scalar"])
+    av.initialize()
+    src.payload.mem = numpy.ones(3, dtype=numpy.float32)
+    src.scalar = 8
+    # Avatar still holds the snapshot taken at initialize.
+    numpy.testing.assert_array_equal(av.payload.mem, [0, 0, 0])
+    assert av.scalar == 7
+    av.run()
+    numpy.testing.assert_array_equal(av.payload.mem, [1, 1, 1])
+    assert av.scalar == 8
+
+
+def test_downloader_unpacks_local_archive(tmp_path):
+    import tarfile
+    from veles_tpu.downloader import Downloader
+    payload = tmp_path / "payload.txt"
+    payload.write_text("hello")
+    archive = tmp_path / "ds.tar"
+    with tarfile.open(archive, "w") as tar:
+        tar.add(payload, arcname="payload.txt")
+    target = tmp_path / "out"
+    wf = DummyWorkflow()
+    dl = Downloader(wf, url="file://" + str(archive),
+                    directory=str(target), files=["payload.txt"])
+    dl.initialize()
+    assert (target / "payload.txt").read_text() == "hello"
+    # Second initialize: short-circuits on existing files.
+    dl2 = Downloader(wf, url="file:///nonexistent",
+                     directory=str(target), files=["payload.txt"])
+    dl2.initialize()

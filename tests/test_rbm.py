@@ -58,11 +58,11 @@ def rbm_trained():
 def test_rbm_reconstruction_improves(rbm_trained):
     d = rbm_trained.decision
     from veles_tpu.loader.base import VALID
-    # epoch_loss holds per-tick mean summed-SE; divide by 784 pixels
-    # for a per-pixel feel — just require a meaningful drop vs the
-    # random-init reconstruction (~0.25/pixel for sigmoid outputs).
-    final = d.epoch_loss[VALID] / 100.0 / 784.0
-    assert final < 0.08, final
+    # epoch_loss is the per-tick mean of the per-sample summed SE
+    # (784 pixels); per-pixel SE of an untrained sigmoid model is
+    # ~0.25 → ~196/sample.  Require a large drop.
+    per_px = d.epoch_loss[VALID] / 784.0
+    assert per_px < 0.08, per_px
 
 
 def test_ae_tied_weights_train():
@@ -78,7 +78,7 @@ def test_ae_tied_weights_train():
     # Tied decoder gradients must reach the encoder weights.
     assert numpy.abs(w1 - w0).max() > 1e-3
     from veles_tpu.loader.base import VALID
-    per_px = wf.decision.epoch_loss[VALID] / 100.0 / 784.0
+    per_px = wf.decision.epoch_loss[VALID] / 784.0
     assert per_px < 0.05, per_px
 
 
@@ -123,6 +123,7 @@ def test_kohonen_som_organizes():
                                           sigma_decay=0.93)
             self.trainer.link_from(self.som)
             self.trainer.input = self.loader.minibatch_data
+            self.trainer.mask = self.loader.minibatch_mask
             self.decision = DecisionBase(self, max_epochs=12)
             self.decision.link_from(self.trainer)
             self.decision.link_attrs(

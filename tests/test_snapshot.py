@@ -1,0 +1,80 @@
+"""Checkpoint/resume gates (reference: veles/snapshotter.py semantics
++ __main__.py:532-582 resume flow)."""
+
+import os
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.launcher import Launcher
+from veles_tpu.snapshotter import (SnapshotterToFile,
+                                   SnapshotterRegistry)
+from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+
+def build(tmp_path, max_epochs):
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=max_epochs,
+                       learning_rate=0.1)
+    snap = SnapshotterToFile(wf, directory=str(tmp_path),
+                             prefix="mnist", time_interval=0.0)
+    snap.link_from(wf.decision)
+    snap.gate_skip = ~wf.decision.improved
+    # Run the snapshotter before the GD chain continues; link suffix.
+    wf.gds[0].unlink_from(wf.decision)
+    wf.gds[0].link_from(snap)
+    snap.link_attrs(wf.decision, ("suffix", "snapshot_suffix"))
+    return launcher, wf, snap
+
+
+def test_registry():
+    assert SnapshotterRegistry.registry["file"] is SnapshotterToFile
+
+
+def test_snapshot_resume_continues_training(tmp_path):
+    prng.reset()
+    prng.get(0).seed(11)
+    launcher, wf, snap = build(tmp_path, max_epochs=2)
+    launcher.initialize()
+    launcher.run()
+    first_err = wf.decision.min_validation_err
+    first_epochs = wf.decision.epoch_number
+    assert snap.destination and os.path.exists(snap.destination)
+    link = os.path.join(str(tmp_path), "mnist_current.lnk")
+    assert os.path.exists(link)
+
+    # Resume from the pointer file with a raised epoch budget.
+    wf2 = SnapshotterToFile.import_(link)
+    assert wf2.decision.epoch_number == first_epochs
+    launcher2 = Launcher()
+    launcher2.add_ref(wf2)
+    wf2.decision.max_epochs = 5
+    launcher2.initialize(snapshot=True)
+    launcher2.run()
+    assert wf2.decision.epoch_number == 5
+    # Training continued (no catastrophic reset): the best validation
+    # error after 3 more epochs is at least as good.
+    assert wf2.decision.min_validation_err <= first_err + 1e-9
+
+
+def test_snapshot_preserves_weights(tmp_path):
+    prng.reset()
+    prng.get(0).seed(12)
+    launcher, wf, snap = build(tmp_path, max_epochs=1)
+    launcher.initialize()
+    launcher.run()
+    wf.forwards[0].weights.map_read()
+    w = numpy.array(wf.forwards[0].weights.mem)
+    wf2 = SnapshotterToFile.import_(snap.destination)
+    numpy.testing.assert_array_equal(wf2.forwards[0].weights.mem, w)
+
+
+def test_snapshot_excludes_launcher(tmp_path):
+    prng.reset()
+    prng.get(0).seed(13)
+    launcher, wf, snap = build(tmp_path, max_epochs=1)
+    launcher.initialize()
+    launcher.run()
+    wf2 = SnapshotterToFile.import_(snap.destination)
+    assert wf2.workflow is None  # live launcher not pickled

@@ -19,4 +19,12 @@ from .plumbing import Repeater, StartPoint, EndPoint, FireStarter  # noqa: F401
 from .memory import Vector, Array  # noqa: F401
 from .launcher import Launcher  # noqa: F401
 from .result_provider import IResultProvider  # noqa: F401
+from .input_joiner import InputJoiner  # noqa: F401
+from .avatar import Avatar  # noqa: F401
+from .downloader import Downloader  # noqa: F401
+from .mean_disp_normalizer import MeanDispNormalizer  # noqa: F401
+from .normalization import (NormalizerRegistry,  # noqa: F401
+                            normalizer_factory)
+from .snapshotter import (SnapshotterBase, SnapshotterToFile,  # noqa: F401
+                          SnapshotterRegistry)
 from . import prng  # noqa: F401

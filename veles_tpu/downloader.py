@@ -1,0 +1,63 @@
+"""Dataset downloader unit.
+
+Capability parity with the reference (reference: veles/downloader.py —
+``Downloader:56``): fetches a dataset archive before ``load_data`` and
+unpacks it into the datasets directory, skipping the download when the
+expected files already exist.
+"""
+
+import os
+import shutil
+import tarfile
+import urllib.request
+import zipfile
+
+from .config import root, get as config_get
+from .units import Unit
+
+
+class Downloader(Unit):
+    """kwargs: ``url`` — archive or file location (http/https/file);
+    ``directory`` — target dir (default root.common.dirs.datasets);
+    ``files`` — names whose presence short-circuits the fetch."""
+
+    def __init__(self, workflow, **kwargs):
+        self.url = kwargs.get("url")
+        self.directory = kwargs.get(
+            "directory", config_get(root.common.dirs.datasets, "."))
+        self.files = list(kwargs.get("files", ()))
+        super(Downloader, self).__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+
+    @property
+    def already_present(self):
+        return self.files and all(
+            os.path.exists(os.path.join(self.directory, f))
+            for f in self.files)
+
+    def initialize(self, **kwargs):
+        super(Downloader, self).initialize(**kwargs)
+        if self.already_present:
+            self.debug("dataset already present in %s", self.directory)
+            return
+        if not self.url:
+            raise ValueError("%s: no url and files missing" % self)
+        os.makedirs(self.directory, exist_ok=True)
+        archive = os.path.join(self.directory,
+                               os.path.basename(self.url) or "dataset")
+        self.info("fetching %s", self.url)
+        with urllib.request.urlopen(self.url) as resp, \
+                open(archive, "wb") as fout:
+            shutil.copyfileobj(resp, fout)
+        self._unpack(archive)
+
+    def _unpack(self, archive):
+        if tarfile.is_tarfile(archive):
+            with tarfile.open(archive) as tar:
+                tar.extractall(self.directory, filter="data")
+            os.remove(archive)
+        elif zipfile.is_zipfile(archive):
+            with zipfile.ZipFile(archive) as z:
+                z.extractall(self.directory)
+            os.remove(archive)
+        # plain files stay as downloaded

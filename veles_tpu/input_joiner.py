@@ -1,0 +1,55 @@
+"""Input joiner unit.
+
+Capability parity with the reference (reference: veles/input_joiner.py
+— ``InputJoiner:49``, backed by the Jinja-templated ocl/join.jcl /
+cuda/join.jcu kernels): concatenates N input Vectors feature-wise into
+one output, registering ``offset_<i>``/``length_<i>`` attributes so
+downstream units can address sub-ranges.
+
+TPU-era mapping: a traced ``jnp.concatenate`` that XLA fuses with its
+consumers — the templated multi-input copy kernel disappears.
+"""
+
+import numpy
+
+from .accelerated_units import TracedUnit
+from .memory import Vector
+
+
+class InputJoiner(TracedUnit):
+    def __init__(self, workflow, **kwargs):
+        super(InputJoiner, self).__init__(workflow, **kwargs)
+        self.view_group = "WORKER"
+        self.inputs = list(kwargs.get("inputs", ()))
+        self.output = Vector()
+
+    def link_inputs(self, *vectors):
+        self.inputs.extend(vectors)
+        return self
+
+    def initialize(self, device=None, **kwargs):
+        if not self.inputs:
+            raise ValueError("%s has no inputs" % self)
+        if any(not v for v in self.inputs):
+            raise AttributeError(
+                "%s: inputs not allocated yet" % self.name)
+        super(InputJoiner, self).initialize(device=device, **kwargs)
+        batch = self.inputs[0].shape[0]
+        offset = 0
+        for i, v in enumerate(self.inputs):
+            length = v.size // batch
+            setattr(self, "offset_%d" % i, offset)
+            setattr(self, "length_%d" % i, length)
+            offset += length
+        self.output.mem = numpy.zeros((batch, offset),
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        parts = []
+        for v in self.inputs:
+            x = read(v)
+            parts.append(x.reshape(x.shape[0], -1).astype(
+                jnp.float32))
+        write(self.output, jnp.concatenate(parts, axis=1))

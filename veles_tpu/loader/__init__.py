@@ -1,3 +1,11 @@
 from .base import (CLASS_NAME, TEST, VALID, TRAIN, Loader, ILoader,
                    UserLoaderRegistry)  # noqa: F401
 from .fullbatch import FullBatchLoader  # noqa: F401
+from .image import (ImageLoaderBase, FileImageLoader,  # noqa: F401
+                    AutoLabelFileImageLoader)
+from .pickles import PicklesLoader  # noqa: F401
+from .hdf5 import HDF5Loader  # noqa: F401
+from .saver import (MinibatchesSaver, MinibatchesLoader,  # noqa: F401
+                    read_minibatch_stream)
+from .interactive import (QueueLoader, InteractiveLoader,  # noqa: F401
+                          RestfulLoader)

@@ -128,8 +128,14 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         if self.class_lengths[TRAIN] > 0 and self.train_ratio < 1.0:
             self.class_lengths[TRAIN] = max(
                 1, int(self.class_lengths[TRAIN] * self.train_ratio))
-        self.shuffled_indices.mem = numpy.arange(
-            self.total_samples, dtype=numpy.int32)
+        resumed = bool(self.shuffled_indices) and \
+            self.shuffled_indices.size == self.total_samples
+        if not resumed:
+            # Fresh run; a snapshot resume keeps the pickled index
+            # order + global_offset so the epoch continues mid-walk
+            # (reference: loader state rides the workflow pickle).
+            self.shuffled_indices.mem = numpy.arange(
+                self.total_samples, dtype=numpy.int32)
         self.minibatch_indices.mem = numpy.zeros(
             self.max_minibatch_size, dtype=numpy.int32)
         self.minibatch_mask.mem = numpy.zeros(
@@ -137,7 +143,8 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self.minibatch_class_vec.mem = numpy.zeros(
             (), dtype=numpy.int32)
         self.create_minibatch_data()
-        self.shuffle()
+        if not resumed:
+            self.shuffle()
 
     def shuffle(self):
         """Shuffles ONLY the train tail of the index space

@@ -1,0 +1,104 @@
+"""Queue-fed loaders: interactive and RESTful ingestion.
+
+Capability parity with the reference (reference: veles/loader/
+interactive.py — ``InteractiveLoader:57`` fed from IPython;
+veles/loader/restful.py — ``RestfulLoader:52`` fed by HTTP POSTs;
+veles/zmq_loader.py — ``ZeroMQLoader:74`` fed by external producers):
+all three are the same shape — a loader whose minibatches arrive from
+an external producer through a thread-safe queue instead of a dataset
+file.  :class:`QueueLoader` is that shape; the RESTful API unit and
+the interactive shell push into it.
+"""
+
+import queue
+
+import numpy
+
+from .base import Loader, TEST
+
+
+class QueueLoader(Loader):
+    """Serves externally-submitted samples (inference streams).
+
+    Producers call :meth:`feed` (blocking queue put); each tick takes
+    up to ``minibatch_size`` pending samples, pads, and publishes them
+    as a TEST-class minibatch.  ``stop()`` unblocks consumers.
+    """
+
+    MAPPING = "queue"
+
+    def __init__(self, workflow, **kwargs):
+        super(QueueLoader, self).__init__(workflow, **kwargs)
+        from ..memory import Vector
+        self.sample_shape = tuple(kwargs.get("sample_shape", ()))
+        self.minibatch_data = Vector()
+        self.minibatch_labels = Vector()
+        self.minibatch_contexts = []
+        self.queue = queue.Queue(
+            maxsize=kwargs.get("queue_size", 1024))
+        self._sentinel = object()
+
+    def feed(self, sample, context=None):
+        """Producer side: submit one sample (+ opaque context handed
+        back with results)."""
+        self.queue.put((numpy.asarray(sample, dtype=numpy.float32),
+                        context))
+
+    def load_data(self):
+        if not self.sample_shape:
+            raise ValueError("%s requires sample_shape" % self)
+        # A queue has no dataset: advertise one TEST pseudo-sample so
+        # epoch accounting stays well-formed.
+        self.class_lengths = [1, 0, 0]
+
+    def create_minibatch_data(self):
+        self.minibatch_data.mem = numpy.zeros(
+            (self.max_minibatch_size,) + self.sample_shape,
+            dtype=numpy.float32)
+        self.minibatch_labels.mem = numpy.zeros(
+            self.max_minibatch_size, dtype=numpy.int32)
+        self.minibatch_contexts = [None] * self.max_minibatch_size
+
+    def serve_next_minibatch(self, slave_id=None):
+        self.minibatch_class = TEST
+        self.last_minibatch = True
+        self.epoch_ended = True
+        return []
+
+    def fill_minibatch(self):
+        """Blocks for the first sample, then drains up to a full
+        minibatch."""
+        data = self.minibatch_data.mem
+        mask = numpy.zeros(self.max_minibatch_size,
+                           dtype=numpy.float32)
+        count = 0
+        while count < self.max_minibatch_size:
+            try:
+                item = self.queue.get(block=(count == 0))
+            except queue.Empty:
+                break
+            if item is self._sentinel:
+                break
+            sample, context = item
+            data[count] = sample.reshape(self.sample_shape)
+            self.minibatch_contexts[count] = context
+            count += 1
+        self.minibatch_data.mem = data
+        mask[:count] = 1.0
+        self.minibatch_mask.mem = mask
+        self.minibatch_size = count
+
+    def stop(self):
+        self.queue.put(self._sentinel)
+
+
+class InteractiveLoader(QueueLoader):
+    """IPython-session ergonomics alias (reference
+    interactive.py:57)."""
+    MAPPING = "interactive"
+
+
+class RestfulLoader(QueueLoader):
+    """HTTP-fed alias used by veles_tpu.restful_api
+    (reference restful.py:52)."""
+    MAPPING = "restful"

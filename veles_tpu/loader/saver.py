@@ -1,0 +1,115 @@
+"""Minibatch stream dump/replay.
+
+Capability parity with the reference (reference: veles/loader/saver.py
+— ``MinibatchesSaver``/``MinibatchesLoader``): dump the preprocessed
+minibatch stream of a run to disk, then feed later runs from the dump
+(skipping the original decode/normalize pipeline).
+"""
+
+import gzip
+import pickle
+
+import numpy
+
+from ..error import BadFormatError
+from ..units import Unit
+from .fullbatch import FullBatchLoader
+
+MAGIC = b"VTPUMB1\n"
+
+
+class MinibatchesSaver(Unit):
+    """Appends every served minibatch to a (gzipped) pickle stream.
+    Link after the loader:
+    ``saver.link_attrs(loader, "minibatch_data", "minibatch_labels",
+    "minibatch_mask", "minibatch_class")``."""
+
+    def __init__(self, workflow, **kwargs):
+        self.file_name = kwargs.get("file_name", "minibatches.dmp.gz")
+        self.compression = kwargs.get("compression", "gz")
+        super(MinibatchesSaver, self).__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self._fout_ = None
+        self.demand("minibatch_data", "minibatch_mask",
+                    "minibatch_class")
+
+    def initialize(self, **kwargs):
+        super(MinibatchesSaver, self).initialize(**kwargs)
+        opener = gzip.open if self.compression == "gz" else open
+        self._fout_ = opener(self.file_name, "wb")
+        self._fout_.write(MAGIC)
+
+    def run(self):
+        self.minibatch_data.map_read()
+        labels = getattr(self, "minibatch_labels", None)
+        if labels is not None and labels:
+            labels.map_read()
+            labels = numpy.array(labels.mem)
+        else:
+            labels = None
+        self.minibatch_mask.map_read()
+        mask = numpy.array(self.minibatch_mask.mem)
+        record = {
+            "data": numpy.array(self.minibatch_data.mem),
+            "labels": labels,
+            "mask": mask,
+            "class": int(self.minibatch_class),
+        }
+        pickle.dump(record, self._fout_,
+                    protocol=pickle.HIGHEST_PROTOCOL)
+
+    def stop(self):
+        if self._fout_ is not None:
+            self._fout_.close()
+            self._fout_ = None
+
+
+def read_minibatch_stream(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as fin:
+        if fin.read(len(MAGIC)) != MAGIC:
+            raise BadFormatError("%s is not a minibatch dump" % path)
+        while True:
+            try:
+                yield pickle.load(fin)
+            except EOFError:
+                return
+
+
+class MinibatchesLoader(FullBatchLoader):
+    """Replays a dump as a fullbatch dataset (valid rows only,
+    grouped by sample class)."""
+
+    MAPPING = "minibatches"
+
+    def __init__(self, workflow, **kwargs):
+        super(MinibatchesLoader, self).__init__(workflow, **kwargs)
+        self.file_name = kwargs["file_name"]
+
+    def load_data(self):
+        per_class = {0: ([], []), 1: ([], []), 2: ([], [])}
+        for rec in read_minibatch_stream(self.file_name):
+            valid = rec["mask"] > 0
+            arrs, labs = per_class[rec["class"]]
+            arrs.append(rec["data"][valid])
+            if rec["labels"] is not None:
+                labs.append(rec["labels"][valid])
+        datas, labels = [], []
+        lengths = [0, 0, 0]
+        have_labels = False
+        for cls in (0, 1, 2):
+            arrs, labs = per_class[cls]
+            if not arrs:
+                continue
+            data = numpy.concatenate(arrs)
+            lengths[cls] = len(data)
+            datas.append(data)
+            if labs:
+                have_labels = True
+                labels.append(numpy.concatenate(labs))
+        if not datas:
+            raise BadFormatError("dump %s is empty" % self.file_name)
+        self.original_data.mem = numpy.concatenate(datas)
+        if have_labels:
+            self.original_labels.mem = numpy.concatenate(labels)
+        self.class_lengths = lengths

@@ -103,7 +103,13 @@ class EvaluatorMSE(EvaluatorBase):
 
     Links: ``input`` ← last layer output; ``target`` ← loader's
     ``minibatch_targets`` (or data for autoencoders); ``mask``.
+
+    ``OWNS_LOSS=False`` subclasses (EvaluatorRBM) compute the same
+    metrics without claiming the step loss — used when another unit
+    (e.g. the RBM's CD pseudo-loss) is the differentiated objective.
     """
+
+    OWNS_LOSS = True
 
     def __init__(self, workflow, **kwargs):
         super(EvaluatorMSE, self).__init__(workflow, **kwargs)
@@ -128,7 +134,8 @@ class EvaluatorMSE(EvaluatorBase):
         se = ((y.reshape(batch, -1) - t.reshape(batch, -1)) ** 2
               ).sum(axis=1)
         loss = (se * mask).sum() / n_valid
-        ctx.set_loss(loss)
+        if self.OWNS_LOSS:
+            ctx.set_loss(loss)
         metric = jnp.sqrt(loss) if self.root_metric else loss
         ctx.add_metric("mse", metric)
         ctx.add_metric("n_valid", mask.sum())

@@ -93,6 +93,7 @@ class KohonenTrainer(ForwardBase):
     def __init__(self, workflow, **kwargs):
         super(KohonenTrainer, self).__init__(workflow, **kwargs)
         self.forward = kwargs["forward"]
+        self.mask = None  # linked: loader.minibatch_mask
         self.sigma0 = kwargs.get("sigma0",
                                  max(self.forward.shape) / 2.0)
         self.sigma_min = kwargs.get("sigma_min", 0.5)
@@ -133,15 +134,28 @@ class KohonenTrainer(ForwardBase):
         gd2 = ((grid[winners][:, None, :] - grid[None, :, :]) ** 2
                ).sum(-1)
         h = jax.lax.stop_gradient(jnp.exp(-gd2 / (2.0 * sigma ** 2)))
+        # Padded rows of partial minibatches must not act as data
+        # points at the origin.
+        if self.mask is not None:
+            m = read(self.mask)
+            h = h * m[:, None]
+            denom = jnp.maximum(m.sum(), 1.0)
+        else:
+            denom = float(x.shape[0])
         # ½·Σ h·‖x−w‖² via the MXU-friendly expansion (no (B,N,D)
         # tensor materialized; ∂/∂w gives the Kohonen update).
-        loss = 0.5 * (h * self.forward.distances(x, w)).sum() / \
-            x.shape[0]
+        loss = 0.5 * (h * self.forward.distances(x, w)).sum() / denom
         ctx.set_loss(loss)
         ctx.add_metric("som_quant_err", jnp.sqrt(
             jnp.take_along_axis(d, winners[:, None], 1).mean()))
         if state is not None:
-            return {"ticks": t + 1.0}
+            # σ decays with TRAINED ticks only (ctx.training may be a
+            # static bool or a traced 0/1 scalar in block mode).
+            if isinstance(ctx.training, bool):
+                inc = 1.0 if ctx.training else 0.0
+            else:
+                inc = (ctx.training > 0).astype(jnp.float32)
+            return {"ticks": t + inc}
 
 
 class GDKohonen(GradientDescentBase):

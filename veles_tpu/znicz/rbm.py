@@ -28,7 +28,7 @@ import numpy
 
 from ..memory import Vector
 from . import nn_units
-from .evaluator import EvaluatorBase
+from .evaluator import EvaluatorMSE
 from .nn_units import ForwardBase, GradientDescentBase
 
 
@@ -49,6 +49,7 @@ class RBM(ForwardBase):
         if isinstance(self.output_sample_shape, int):
             self.output_sample_shape = (self.output_sample_shape,)
         self.cd_k = kwargs.get("cd_k", 1)
+        self.mask = None  # linked: loader.minibatch_mask
         self.vbias = Vector()  # visible bias (b)
         self.reconstruction = Vector()
 
@@ -122,8 +123,15 @@ class RBM(ForwardBase):
         vk = jax.lax.stop_gradient(vk)
         write(self.reconstruction, vk)
         # CD-k pseudo-loss: grad == positive − negative statistics.
-        loss = (self._free_energy(v0, w, b, c) -
-                self._free_energy(vk, w, b, c)).mean()
+        # Padded rows of partial minibatches carry no statistics —
+        # mask them like every other loss-setting unit does.
+        per_sample = (self._free_energy(v0, w, b, c) -
+                      self._free_energy(vk, w, b, c))
+        if self.mask is not None:
+            m = read(self.mask)
+            loss = (per_sample * m).sum() / jnp.maximum(m.sum(), 1.0)
+        else:
+            loss = per_sample.mean()
         ctx.set_loss(loss)
 
 
@@ -132,31 +140,13 @@ class GDRBM(GradientDescentBase):
     MAPPING = "rbm"
 
 
-class EvaluatorRBM(EvaluatorBase):
-    """Reconstruction-MSE metrics for RBM pretraining.  Does NOT set
-    the step loss — the RBM's CD pseudo-loss is the differentiated
-    objective; this unit only feeds Decision's epoch accounting."""
+class EvaluatorRBM(EvaluatorMSE):
+    """Reconstruction-MSE metrics for RBM pretraining: identical to
+    EvaluatorMSE except it does NOT claim the step loss — the RBM's
+    CD pseudo-loss is the differentiated objective; this unit only
+    feeds Decision's epoch accounting."""
 
-    def __init__(self, workflow, **kwargs):
-        super(EvaluatorRBM, self).__init__(workflow, **kwargs)
-        self.target = None  # linked: loader minibatch data
-        self.demand("target", "mask", "minibatch_class_vec")
-
-    def tforward(self, read, write, params, ctx, state=None):
-        import jax.numpy as jnp
-        recon = read(self.input).astype(jnp.float32)
-        t = read(self.target)
-        t = t.reshape(t.shape[0], -1).astype(jnp.float32)
-        mask = read(self.mask)
-        n_valid = jnp.maximum(mask.sum(), 1.0)
-        se = ((recon - t) ** 2).sum(axis=1)
-        mse = (se * mask).sum() / n_valid
-        ctx.add_metric("rmse", jnp.sqrt(mse))
-        ctx.add_metric("n_valid", mask.sum())
-        # err column carries the summed SE → Decision reports epoch
-        # MSE through the same accumulator as classification error.
-        return self._accumulate(read, state, (se * mask).sum(),
-                                mask.sum(), mse)
+    OWNS_LOSS = False
 
 
 class All2AllDeconv(ForwardBase):

@@ -40,6 +40,7 @@ class MnistRBMWorkflow(AcceleratedWorkflow):
                        cd_k=cd_k, weights_stddev=0.01)
         self.rbm.link_from(self.loader)
         self.rbm.input = self.loader.minibatch_data
+        self.rbm.mask = self.loader.minibatch_mask
 
         self.evaluator = EvaluatorRBM(self)
         self.evaluator.link_from(self.rbm)
