@@ -546,3 +546,82 @@ class AcceleratedWorkflow(Workflow):
         import jax
         return {k: jax.device_get(v)
                 for k, v in self.step_metrics.items()}
+
+    # -- master–slave protocol bridging (reference: workflow.py:445-543
+    # aggregated IDistributable; server/client drive these) ---------------
+
+    @property
+    def decision_unit(self):
+        return getattr(self, "decision", None)
+
+    def should_stop_serving(self):
+        """Master-side serve predicate (consulted by Server)."""
+        d = self.decision_unit
+        if d is not None:
+            return bool(d.complete)
+        return bool(self.stopped)
+
+    def generate_data_for_slave(self, slave=None):
+        """A job = unit pieces (loader indices, layer trainables) plus
+        the serve-time flags the master's decision needs echoed back
+        with the update."""
+        data = super(AcceleratedWorkflow,
+                     self).generate_data_for_slave(slave)
+        loader = getattr(self, "loader", None)
+        if loader is not None:
+            data["__job__"] = {
+                "minibatch_class": loader.minibatch_class,
+                "last_minibatch": bool(loader.last_minibatch),
+                "epoch_ended": bool(loader.epoch_ended),
+                "epoch_number": loader.epoch_number,
+            }
+        return data
+
+    def apply_data_from_master(self, data):
+        super(AcceleratedWorkflow, self).apply_data_from_master(data)
+        if data and "__job__" in data:
+            self._job_meta_ = data["__job__"]
+
+    def do_job(self, data, update, callback):
+        """Worker-side job execution: apply master data, run ONE fused
+        tick (the job's minibatch), return updated trainables +
+        metrics.  (The reference ran the whole gate-driven graph per
+        job, workflow.py:545; with the fused step that collapses to
+        one compiled call.)"""
+        self.apply_data_from_master(data)
+        if update is not None:
+            self.apply_update_from_master(update)
+        meta = getattr(self, "_job_meta_", None) or {}
+        from .loader.base import TRAIN
+        training = meta.get("minibatch_class", TRAIN) == TRAIN
+        self.begin_tick()
+        from . import prng
+        metrics = self.compiler.execute(key=prng.get().jax_key(),
+                                        training=training)
+        import jax
+        host_metrics = {k: float(jax.device_get(v))
+                        for k, v in metrics.items()}
+        result = self.generate_data_for_master()
+        result["__metrics__"] = host_metrics
+        result["__job__"] = meta
+        callback(result)
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Master-side update application + decision bookkeeping."""
+        meta = (data or {}).pop("__job__", None)
+        metrics = (data or {}).pop("__metrics__", None)
+        super(AcceleratedWorkflow, self).apply_data_from_slave(
+            data, slave)
+        d = self.decision_unit
+        if d is None or meta is None:
+            return
+        cls = meta.get("minibatch_class")
+        if metrics is not None and hasattr(d, "accumulate_remote"):
+            d.accumulate_remote(cls, metrics)
+        if meta.get("last_minibatch") and \
+                hasattr(d, "finish_remote_class"):
+            # (decision.epoch_number stays linked to the master
+            # loader, which advanced at serve time.)
+            d.finish_remote_class(cls)
+            if meta.get("epoch_ended"):
+                d.on_epoch_ended()

@@ -1,0 +1,144 @@
+"""Worker (slave) side of the distributed job protocol.
+
+Capability parity with the reference slave (reference: veles/client.py
+— reconnecting client ``Client:405`` with FSM ``:177-195``, handshake
+sending power/mid/pid ``:362-373``, job loop request_job → do_job →
+request_update ``:278-342``, ``--slave-death-probability`` fault
+injection ``:302-307,438-442``, bounded reconnect attempts
+``:488-507``, periodic power re-measurement ``:308-313``).
+"""
+
+import os
+import random
+import time
+
+from .logger import Logger
+from .network_common import (connect, machine_id, recv_message,
+                             send_message)
+
+
+def measure_computing_power(repeats=2, n=1024):
+    """GEMM-throughput scalar used for load balancing (reference:
+    accelerated_units.py:699-817 ``DeviceBenchmark`` — 1000/dt of a
+    big matmul)."""
+    import numpy
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda a: a @ a)
+    numpy.array(jax.device_get(f(x)[0, 0]))  # warm/compile
+    t0 = time.time()
+    for _ in range(repeats):
+        x = f(x)
+    numpy.array(jax.device_get(x[0, 0]))
+    return 1000.0 / max(time.time() - t0, 1e-6)
+
+
+class Client(Logger):
+    """Connects to a coordinator and executes jobs
+    (reference: client.py:405)."""
+
+    def __init__(self, address, workflow, **kwargs):
+        super(Client, self).__init__()
+        self.address = address
+        self.workflow = workflow
+        self.death_probability = kwargs.get("death_probability", 0.0)
+        self.reconnect_attempts = kwargs.get("reconnect_attempts", 5)
+        self.reconnect_delay = kwargs.get("reconnect_delay", 0.2)
+        self.poll_delay = kwargs.get("poll_delay", 0.05)
+        self.power = kwargs.get("power") or 1.0
+        self.measure_power = kwargs.get("measure_power", False)
+        self.id = None
+        self.jobs_done = 0
+        self._stop = False
+
+    def stop(self):
+        self._stop = True
+
+    def run(self):
+        """Blocking job loop with bounded reconnects
+        (reference FSM: connect → handshake → job cycle)."""
+        attempts = 0
+        while not self._stop and attempts <= self.reconnect_attempts:
+            try:
+                sock = connect(self.address, timeout=30.0)
+            except OSError:
+                attempts += 1
+                time.sleep(self.reconnect_delay * attempts)
+                continue
+            try:
+                if not self._handshake(sock):
+                    attempts += 1
+                    time.sleep(self.reconnect_delay * attempts)
+                    continue
+                attempts = 0
+                if self._job_cycle(sock):
+                    return  # orderly bye
+            except (OSError, ConnectionError):
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            attempts += 1
+            time.sleep(self.reconnect_delay * attempts)
+
+    # -- phases ------------------------------------------------------------
+
+    def _handshake(self, sock):
+        if self.measure_power:
+            self.power = measure_computing_power()
+        send_message(sock, {
+            "cmd": "handshake",
+            "checksum": self.workflow.checksum,
+            "mid": machine_id(),
+            "pid": os.getpid(),
+            "power": self.power,
+        })
+        reply = recv_message(sock)
+        if not reply or reply.get("cmd") != "handshake_ack":
+            self.warning("handshake rejected: %s", reply)
+            return False
+        self.id = reply["id"]
+        initial = reply.get("initial")
+        if initial:
+            self.workflow.apply_data_from_master(initial)
+        self.info("joined as %s", self.id)
+        return True
+
+    def _job_cycle(self, sock):
+        """Returns True on orderly completion."""
+        while not self._stop:
+            send_message(sock, {"cmd": "job_request"})
+            msg = recv_message(sock)
+            if msg is None:
+                return False
+            cmd = msg.get("cmd")
+            if cmd == "bye":
+                return True
+            if cmd == "no_job":
+                time.sleep(self.poll_delay)
+                continue
+            if cmd != "job":
+                continue
+            if self.death_probability and \
+                    random.random() < self.death_probability:
+                # Chaos testing (reference: client.py:438-442).
+                self.warning("simulating slave death")
+                os._exit(1)
+            result = {}
+
+            def capture(data):
+                result["update"] = data
+
+            self.workflow.do_job(msg["data"], None, capture)
+            self.jobs_done += 1
+            send_message(sock, {"cmd": "update",
+                                "data": result.get("update")})
+            ack = recv_message(sock)
+            if ack is None:
+                return False
+            if ack.get("cmd") == "bye":
+                return True
+        return True

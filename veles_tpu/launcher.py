@@ -31,6 +31,18 @@ class Launcher(Logger):
         self.interactive = interactive
         self.workflow = None
         self._mode = kwargs.get("mode", "standalone")
+        # Master–slave control plane (reference -l/-m flags,
+        # launcher.py:333-342): ``listen_address`` turns this process
+        # into a coordinator; ``master_address`` into a worker.
+        self.listen_address = kwargs.get("listen_address")
+        self.master_address = kwargs.get("master_address")
+        if self.listen_address and self._mode == "standalone":
+            self._mode = "master"
+        if self.master_address and self._mode == "standalone":
+            self._mode = "slave"
+        self.slave_kwargs = kwargs.get("slave_kwargs", {})
+        self.server = None
+        self.client = None
         self._running = threading.Event()
         self._finished = threading.Event()
         self.device = None
@@ -93,25 +105,49 @@ class Launcher(Logger):
             backends.Device.create(
                 config_get(root.common.engine.backend, "auto"))
         self.workflow.initialize(device=self.device, **kwargs)
+        if self.is_master and self.listen_address:
+            from .server import Server
+            self.server = Server(self.listen_address, self.workflow,
+                                 on_stopped=self.on_workflow_finished)
+        elif self.is_slave and self.master_address:
+            from .client import Client
+            self.client = Client(self.master_address, self.workflow,
+                                 **self.slave_kwargs)
         return self
 
     def run(self):
         """Runs the workflow to completion (blocking)
-        (reference: launcher.py:551)."""
+        (reference: launcher.py:551).
+
+        Master mode: the Server thread pool drives the workflow via
+        the job protocol; this thread just waits.  Slave mode: the
+        Client job loop runs here.  Standalone: the graph runs here.
+        """
         self._start_time = time.time()
         self._running.set()
         self._finished.clear()
         try:
-            self.workflow.run()
-            self._finished.wait()
+            if self.server is not None:
+                self.server.wait()
+            elif self.client is not None:
+                self.client.run()
+            else:
+                self.workflow.run()
+                self._finished.wait()
         finally:
             self._running.clear()
+            if self.server is not None:
+                self.server.stop()
             self.workflow.print_stats()
 
     def on_workflow_finished(self):
         self._finished.set()
 
     def stop(self):
+        if self.server is not None:
+            self.server.stop()
+        if self.client is not None:
+            self.client.stop()
         if self.workflow is not None and self.workflow.is_running:
             self.workflow.stop()
         self._finished.set()

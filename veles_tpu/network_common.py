@@ -1,0 +1,91 @@
+"""Control-plane networking primitives.
+
+Capability parity with the reference (reference: veles/network_common.py
+— ``NetworkAgent:72``, machine id ``mid:104-118``; the message framing
+role of veles/txzmq/connection.py): address parsing, machine identity,
+and length-prefixed pickle framing over plain TCP sockets.
+
+TPU-era scope note: BULK data (gradients/weights) moves over ICI/DCN
+via XLA collectives (see parallel/); this channel carries only control
+traffic — handshakes, minibatch indices, small state — so a simple
+framed-pickle protocol over TCP replaces the reference's
+Twisted+ZeroMQ stack (SURVEY §5 "Distributed communication backend").
+Payloads may optionally be gzip-compressed (the reference offered
+snappy/gzip/xz codecs, txzmq/connection.py:484-560).
+"""
+
+import gzip
+import pickle
+import socket
+import struct
+import uuid
+
+_HEADER = struct.Struct(">QB")  # payload length, flags
+_FLAG_GZIP = 1
+
+#: Payloads above this size are compressed (control messages are tiny;
+#: index arrays for big blocks may not be).
+COMPRESS_THRESHOLD = 1 << 16
+
+
+def parse_address(address, default_port=5050):
+    """"host:port" | "host" | ":port" → (host, port)
+    (reference: network_common.py address parsing)."""
+    if isinstance(address, (tuple, list)):
+        return address[0], int(address[1])
+    host, sep, port = str(address).rpartition(":")
+    if not sep:
+        return address or "0.0.0.0", default_port
+    return host or "0.0.0.0", int(port)
+
+
+def machine_id():
+    """Stable-ish machine identity (reference: network_common.py:104
+    built it from the dbus id + MACs)."""
+    return "%012x" % uuid.getnode()
+
+
+def send_message(sock, obj):
+    """Frames and sends one pickled message (blocking)."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    flags = 0
+    if len(payload) >= COMPRESS_THRESHOLD:
+        packed = gzip.compress(payload, compresslevel=1)
+        if len(packed) < len(payload):
+            payload = packed
+            flags |= _FLAG_GZIP
+    sock.sendall(_HEADER.pack(len(payload), flags) + payload)
+
+
+def recv_message(sock):
+    """Receives one framed message; None on orderly close."""
+    header = _recv_exact(sock, _HEADER.size)
+    if header is None:
+        return None
+    length, flags = _HEADER.unpack(header)
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    if flags & _FLAG_GZIP:
+        payload = gzip.decompress(payload)
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except (ConnectionResetError, OSError):
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def connect(address, timeout=None):
+    host, port = parse_address(address)
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock

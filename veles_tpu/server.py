@@ -1,0 +1,258 @@
+"""Coordinator (master) side of the distributed job protocol.
+
+Capability parity with the reference master (reference: veles/server.py
+— ``VelesProtocol:194`` with its WAIT→WORK FSM ``:230-254``, handshake
+with workflow-checksum verification ``:478-529``, job generation
+deferred off the IO loop ``:596-611``, update application + ack
+``:401-430``, hang detection/blacklist ``:369-395``, adaptive job
+timeout mean+3σ ``:619-635``, slave drop → ``workflow.drop_slave``
+``:315-338``, pause/resume ``:734-745``).
+
+TPU-era scope: SPMD over a mesh is the fast path for on-pod data
+parallelism (parallel/); this protocol is the *control-plane* engine —
+elastic workers joining/leaving over plain TCP, minibatch indices out,
+updates back — the role the reference's Twisted+ZMQ master played.
+Threads replace the reactor: one acceptor + one handler thread per
+worker, with a single lock serializing workflow access.
+
+Aggregation semantics: each job ships the trainables' current values
+(ForwardBase.generate_data_for_slave); the worker runs its ticks
+locally and returns its updated values; the master applies the DIFF
+against what it shipped that worker (delayed/async SGD — the
+reference's per-unit apply_data_from_slave aggregation point,
+workflow.py:518-535).
+"""
+
+import socket
+import statistics
+import threading
+import time
+
+from .logger import Logger
+from .network_common import (machine_id, parse_address, recv_message,
+                             send_message)
+
+
+class SlaveDescription(object):
+    """Per-worker bookkeeping (reference: server.py:172)."""
+
+    def __init__(self, sid, mid, power, address):
+        self.id = sid
+        self.mid = mid
+        self.power = power
+        self.address = address
+        self.state = "WAIT"
+        self.jobs_done = 0
+        self.job_times = []
+        self.job_started = None
+        self.blacklisted = False
+        self.paused = False
+
+
+class Server(Logger):
+    """Listens for workers and drives the job/update cycle over the
+    master workflow (reference: server.py:659 ``Server``)."""
+
+    def __init__(self, address, workflow, **kwargs):
+        super(Server, self).__init__()
+        self.workflow = workflow
+        self.host, self.port = parse_address(address)
+        self._sock = socket.socket(socket.AF_INET,
+                                   socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR,
+                              1)
+        self._sock.bind((self.host, self.port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(16)
+        self._lock = threading.Lock()        # serializes workflow
+        self._slaves = {}
+        self._slave_seq = 0
+        self._stop = threading.Event()
+        self.on_stopped = kwargs.get("on_stopped")
+        #: jobs handed out but not yet answered, per slave id
+        self._outstanding = {}
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="veles-server-accept")
+        self._accept_thread.start()
+        self.info("coordinator listening on %s:%d", self.host,
+                  self.port)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def is_running(self):
+        return not self._stop.is_set()
+
+    def stop(self):
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self.on_stopped is not None:
+            self.on_stopped()
+
+    def wait(self, timeout=None):
+        """Blocks until training completes (decision.complete on the
+        master workflow stops the server)."""
+        self._stop.wait(timeout)
+
+    # -- worker management (reference pause/resume/blacklist) --------------
+
+    @property
+    def slaves(self):
+        return dict(self._slaves)
+
+    def pause_slave(self, sid):
+        if sid in self._slaves:
+            self._slaves[sid].paused = True
+
+    def resume_slave(self, sid):
+        if sid in self._slaves:
+            self._slaves[sid].paused = False
+
+    def _blacklist_check(self, desc):
+        """Adaptive job timeout: mean+3σ of this worker's history
+        (reference: server.py:619-635)."""
+        if len(desc.job_times) < 4 or desc.job_started is None:
+            return False
+        mean = statistics.mean(desc.job_times)
+        sigma = statistics.pstdev(desc.job_times)
+        if time.time() - desc.job_started > mean + 3 * sigma + 1.0:
+            desc.blacklisted = True
+            return True
+        return False
+
+    # -- protocol ----------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_slave,
+                             args=(conn, addr), daemon=True,
+                             name="veles-server-worker").start()
+
+    def _serve_slave(self, conn, addr):
+        desc = None
+        try:
+            hello = recv_message(conn)
+            if not hello or hello.get("cmd") != "handshake":
+                return
+            # Checksum verification (reference: server.py:484-493).
+            theirs = hello.get("checksum")
+            ours = self.workflow.checksum
+            if theirs != ours:
+                send_message(conn, {"cmd": "error",
+                                    "error": "checksum mismatch",
+                                    "expected": ours})
+                return
+            with self._lock:
+                self._slave_seq += 1
+                sid = "%s/%d" % (hello.get("mid", machine_id()),
+                                 self._slave_seq)
+                desc = SlaveDescription(
+                    sid, hello.get("mid"), hello.get("power", 1.0),
+                    addr)
+                self._slaves[sid] = desc
+                initial = self.workflow.\
+                    generate_initial_data_for_slave(sid)
+            send_message(conn, {"cmd": "handshake_ack", "id": sid,
+                                "initial": initial})
+            self.info("worker %s joined (power %.1f)", sid,
+                      desc.power)
+            self._message_loop(conn, desc)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if desc is not None:
+                self._drop(desc)
+
+    def _message_loop(self, conn, desc):
+        while not self._stop.is_set():
+            msg = recv_message(conn)
+            if msg is None:
+                return
+            cmd = msg.get("cmd")
+            if cmd == "job_request":
+                if desc.paused or desc.blacklisted:
+                    send_message(conn, {"cmd": "no_job",
+                                        "retry": True})
+                    continue
+                job = self._generate_job(desc)
+                if job is None:
+                    if self._maybe_finished():
+                        send_message(conn, {"cmd": "bye"})
+                        return
+                    send_message(conn, {"cmd": "no_job",
+                                        "retry": True})
+                else:
+                    desc.state = "WORK"
+                    desc.job_started = time.time()
+                    send_message(conn, {"cmd": "job", "data": job})
+            elif cmd == "update":
+                self._apply_update(desc, msg["data"])
+                send_message(conn, {"cmd": "update_ack"})
+                if self._maybe_finished():
+                    send_message(conn, {"cmd": "bye"})
+                    return
+            elif cmd == "bye":
+                return
+
+    # -- workflow bridging -------------------------------------------------
+
+    def _generate_job(self, desc):
+        """Serializes one job under the workflow lock
+        (reference: server.py:596-611 deferred generation)."""
+        with self._lock:
+            if self._finished_locked():
+                return None
+            data = self.workflow.generate_data_for_slave(desc.id)
+            self._outstanding[desc.id] = \
+                self._outstanding.get(desc.id, 0) + 1
+            return data
+
+    def _apply_update(self, desc, data):
+        with self._lock:
+            self.workflow.apply_data_from_slave(data, desc.id)
+            desc.state = "WAIT"
+            desc.jobs_done += 1
+            if desc.job_started is not None:
+                desc.job_times.append(time.time() - desc.job_started)
+                desc.job_started = None
+            n = self._outstanding.get(desc.id, 0)
+            if n <= 1:
+                self._outstanding.pop(desc.id, None)
+            else:
+                self._outstanding[desc.id] = n - 1
+
+    def _finished_locked(self):
+        stop = getattr(self.workflow, "should_stop_serving", None)
+        if stop is not None:
+            return bool(stop())
+        return bool(self.workflow.stopped)
+
+    def _maybe_finished(self):
+        with self._lock:
+            done = self._finished_locked() and not self._outstanding
+        if done:
+            self.info("all jobs done — stopping coordinator")
+            self.stop()
+        return done
+
+    def _drop(self, desc):
+        """Connection lost → requeue in-flight work
+        (reference: server.py:315-338)."""
+        with self._lock:
+            self._slaves.pop(desc.id, None)
+            self._outstanding.pop(desc.id, None)
+            self.workflow.drop_slave(desc.id)
+        self.info("worker %s dropped", desc.id)

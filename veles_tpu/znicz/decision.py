@@ -99,6 +99,31 @@ class DecisionGD(DecisionBase, IResultProvider):
         self.epoch_loss[cls] = float(row[2]) / ticks
         self.evaluator.reset_epoch_acc(cls)
 
+    # -- remote (master-side) accumulation: per-tick metrics arrive in
+    # worker updates instead of the on-device epoch accumulator
+    # (reference: evaluator/decision state rode apply_data_from_slave,
+    # workflow.py:518-535) --------------------------------------------
+
+    def init_unpickled(self):
+        super(DecisionGD, self).init_unpickled()
+        self._remote_acc_ = {}
+
+    def accumulate_remote(self, cls, metrics):
+        acc = self._remote_acc_.setdefault(cls, [0.0, 0.0, 0.0, 0.0])
+        acc[0] += float(metrics.get("n_err", 0.0))
+        acc[1] += float(metrics.get("n_valid", 0.0))
+        acc[2] += float(metrics.get("loss", 0.0))
+        acc[3] += 1.0
+
+    def finish_remote_class(self, cls):
+        acc = self._remote_acc_.pop(cls, None)
+        if acc is None:
+            return
+        self.epoch_n_err[cls] = acc[0]
+        self.epoch_n_valid[cls] = acc[1]
+        self.epoch_loss[cls] = acc[2] / max(acc[3], 1.0)
+        self.on_last_minibatch(cls)
+
     def error_rate(self, cls):
         n = self.epoch_n_valid[cls]
         return self.epoch_n_err[cls] / n if n else 0.0

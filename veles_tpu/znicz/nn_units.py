@@ -115,6 +115,64 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
     def rand(self):
         return prng.get(self.prng_key)
 
+    # -- distributed contract (reference: znicz GD units shipped
+    # weights in jobs and aggregated slave results centrally;
+    # workflow.py:518-535 is the core contract) ---------------------------
+
+    def init_unpickled(self):
+        super(ForwardBase, self).init_unpickled()
+        self._shipped_ = {}
+
+    def _trainable_arrays(self):
+        import numpy
+        out = {}
+        for attr, vec in self.trainables.items():
+            vec.map_read()
+            out[attr] = numpy.array(vec.mem)
+        return out
+
+    def generate_data_for_slave(self, slave=None):
+        """Ships current trainables; remembers what each worker got so
+        its update can be applied as a delta."""
+        if not self.trainables:
+            return None
+        arrays = self._trainable_arrays()
+        self._shipped_[slave] = arrays
+        return arrays
+
+    def apply_data_from_master(self, data):
+        if not data:
+            return
+        for attr, arr in data.items():
+            vec = self.trainables.get(attr)
+            if vec is not None:
+                vec.mem = arr
+
+    def generate_data_for_master(self):
+        if not self.trainables:
+            return None
+        return self._trainable_arrays()
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Delta aggregation (delayed/async SGD): the worker trained
+        from the version we shipped it; fold ITS update into OUR
+        current values as (theirs − shipped)."""
+        if not data:
+            return
+        base = self._shipped_.pop(slave, None)
+        for attr, arr in data.items():
+            vec = self.trainables.get(attr)
+            if vec is None:
+                continue
+            if base is not None and attr in base:
+                vec.map_read()  # device copy (if any) is not newer
+                vec.mem = vec.mem + (arr - base[attr])
+            else:
+                vec.mem = arr
+
+    def drop_slave(self, slave=None):
+        self._shipped_.pop(slave, None)
+
 
 class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
     """Per-layer trainer (znicz ``GradientDescentBase`` analogue).
