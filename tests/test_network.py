@@ -117,21 +117,48 @@ def test_drop_slave_on_disconnect():
     master = InstrumentedWorkflow(Launcher())
     master.job_limit = 1000000  # never finishes on its own
     server = Server(":0", master)
-    from veles_tpu.network_common import connect
+    from veles_tpu.network_common import connect, normalize_secret
+    secret = normalize_secret(master.checksum)
     sock = connect("127.0.0.1:%d" % server.port)
     send_message(sock, {"cmd": "handshake",
                         "checksum": master.checksum,
-                        "mid": machine_id(), "pid": 1, "power": 1.0})
-    ack = recv_message(sock)
+                        "mid": machine_id(), "pid": 1, "power": 1.0},
+                 secret)
+    ack = recv_message(sock, secret)
     assert ack["cmd"] == "handshake_ack"
-    send_message(sock, {"cmd": "job_request"})
-    job = recv_message(sock)
+    send_message(sock, {"cmd": "job_request"}, secret)
+    job = recv_message(sock, secret)
     assert job["cmd"] == "job"
     sock.close()  # die mid-job
     deadline = time.time() + 5
     while not master.dropped and time.time() < deadline:
         time.sleep(0.02)
     assert master.dropped == [ack["id"]]
+    server.stop()
+
+
+def test_unauthenticated_frames_rejected():
+    """Frames without the shared-secret HMAC must be dropped BEFORE
+    unpickling (pickle from an unauthenticated peer is arbitrary code
+    execution)."""
+    master = InstrumentedWorkflow(Launcher())
+    master.job_limit = 1000000
+    server = Server(":0", master)
+    from veles_tpu.network_common import connect
+    sock = connect("127.0.0.1:%d" % server.port)
+    sock.settimeout(2.0)
+    # No secret → HMAC missing → server treats the peer as dead.
+    send_message(sock, {"cmd": "handshake",
+                        "checksum": master.checksum,
+                        "mid": machine_id(), "pid": 1, "power": 1.0})
+    import socket as socket_mod
+    try:
+        reply = recv_message(sock)
+    except (socket_mod.timeout, OSError):
+        reply = None
+    assert reply is None
+    assert not server.slaves
+    sock.close()
     server.stop()
 
 
@@ -155,12 +182,18 @@ def test_launcher_master_slave_modes():
     assert slave.jobs_run == 3
 
 
-def _mnist_pair(seed, **kwargs):
+def _mnist_pair(seed, max_epochs=5, **kwargs):
     from veles_tpu.znicz.samples.mnist import MnistWorkflow
     prng.reset()
     prng.get(0).seed(seed)
     launcher = Launcher()
-    wf = MnistWorkflow(launcher, max_epochs=3, learning_rate=0.1,
+    # Momentum is damped vs the standalone sample: async delta
+    # aggregation with two concurrent workers amplifies
+    # momentum-accelerated steps computed against stale weights
+    # (effective step ≈ K·lr/(1−moment)), which at 0.9 makes
+    # convergence a coin flip.
+    wf = MnistWorkflow(launcher, max_epochs=max_epochs,
+                       learning_rate=0.1, gradient_moment=0.5,
                        **kwargs)
     launcher.initialize()
     return launcher, wf
@@ -186,7 +219,9 @@ def test_distributed_mnist_converges():
         t.join(timeout=10)
     assert not server.is_running
     assert bool(master.decision.complete)
-    assert master.decision.epoch_number == 3
-    # Async-DP on the digits fallback: modest gate (standalone
-    # reaches ~4% in 8 epochs; 3 distributed epochs must be < 15%).
+    assert master.decision.epoch_number == 5
+    # Async-DP on the digits fallback: stale-gradient noise from two
+    # concurrent workers makes single-epoch error jittery, so the
+    # gate is modest (standalone reaches ~4% in 8 epochs; observed
+    # range over repeated runs here is 7–12%).
     assert master.decision.min_validation_err < 0.15

@@ -496,6 +496,13 @@ class AcceleratedWorkflow(Workflow):
         self._compiler_ = None
         self._tick_id_ = 0
         self._step_done_tick_ = -1
+        # Master-side job accounting, keyed by (epoch, class): the
+        # epoch-boundary decision must wait until every job served
+        # for that bucket has been answered or requeued, or late
+        # updates would pollute the next epoch's metrics.
+        self._inflight_by_slave_ = {}
+        self._inflight_count_ = {}
+        self._finish_pending_ = {}
 
     @property
     def compiler(self):
@@ -565,16 +572,28 @@ class AcceleratedWorkflow(Workflow):
         """A job = unit pieces (loader indices, layer trainables) plus
         the serve-time flags the master's decision needs echoed back
         with the update."""
+        loader = getattr(self, "loader", None)
+        # The serve below advances epoch_number when it hands out the
+        # epoch's last minibatch, so the PRE-serve value is the only
+        # label every job of this epoch agrees on — it keys the
+        # (epoch, class) accounting bucket.
+        epoch_key = loader.epoch_number if loader is not None else None
         data = super(AcceleratedWorkflow,
                      self).generate_data_for_slave(slave)
-        loader = getattr(self, "loader", None)
         if loader is not None:
-            data["__job__"] = {
+            meta = {
                 "minibatch_class": loader.minibatch_class,
                 "last_minibatch": bool(loader.last_minibatch),
                 "epoch_ended": bool(loader.epoch_ended),
                 "epoch_number": loader.epoch_number,
+                "epoch_key": epoch_key,
             }
+            data["__job__"] = meta
+            key = (epoch_key, meta["minibatch_class"])
+            self._inflight_by_slave_.setdefault(slave, []).append(
+                (key, meta["last_minibatch"], meta["epoch_ended"]))
+            self._inflight_count_[key] = \
+                self._inflight_count_.get(key, 0) + 1
         return data
 
     def apply_data_from_master(self, data):
@@ -610,18 +629,85 @@ class AcceleratedWorkflow(Workflow):
         """Master-side update application + decision bookkeeping."""
         meta = (data or {}).pop("__job__", None)
         metrics = (data or {}).pop("__metrics__", None)
+        if meta is not None:
+            key = (meta.get("epoch_key"), meta.get("minibatch_class"))
+            if not self._release_inflight(slave, key):
+                # Untracked job: it was already dropped/requeued
+                # (e.g. the watchdog blacklisted this worker) — the
+                # batch will be re-trained, so both its deltas and
+                # its metrics must be discarded entirely.
+                return
         super(AcceleratedWorkflow, self).apply_data_from_slave(
             data, slave)
         d = self.decision_unit
         if d is None or meta is None:
             return
         cls = meta.get("minibatch_class")
+        epoch = meta.get("epoch_key")
+        key = (epoch, cls)
         if metrics is not None and hasattr(d, "accumulate_remote"):
-            d.accumulate_remote(cls, metrics)
-        if meta.get("last_minibatch") and \
-                hasattr(d, "finish_remote_class"):
+            d.accumulate_remote(cls, metrics, epoch)
+        if meta.get("last_minibatch"):
+            # Don't finish the class yet: other jobs from the same
+            # (epoch, class) may still be outstanding on other
+            # workers; finishing now would let their metrics leak
+            # into the next epoch's bucket.
+            self._finish_pending_[key] = bool(meta.get("epoch_ended"))
+        self._maybe_finish_remote(key)
+
+    def _release_inflight(self, slave, key):
+        """Removes one tracked job for (slave, key) and decrements
+        the bucket count.  Returns False when no such job is tracked
+        (already released by a drop)."""
+        lst = self._inflight_by_slave_.get(slave)
+        if not lst:
+            return False
+        for i, (k, _last, _ended) in enumerate(lst):
+            if k == key:
+                lst.pop(i)
+                break
+        else:
+            return False
+        if not lst:
+            self._inflight_by_slave_.pop(slave, None)
+        n = self._inflight_count_.get(key, 0)
+        if n <= 1:
+            self._inflight_count_.pop(key, None)
+        else:
+            self._inflight_count_[key] = n - 1
+        return True
+
+    def _maybe_finish_remote(self, key):
+        """Fires the deferred epoch-boundary decision once every job
+        served for (epoch, class) has been answered or requeued."""
+        if key not in self._finish_pending_ or \
+                self._inflight_count_.get(key, 0) > 0:
+            return
+        epoch_ended = self._finish_pending_.pop(key)
+        d = self.decision_unit
+        if d is None:
+            return
+        epoch, cls = key
+        if hasattr(d, "finish_remote_class"):
             # (decision.epoch_number stays linked to the master
             # loader, which advanced at serve time.)
-            d.finish_remote_class(cls)
-            if meta.get("epoch_ended"):
+            d.finish_remote_class(cls, epoch)
+            if epoch_ended:
                 d.on_epoch_ended()
+
+    def drop_slave(self, slave=None):
+        """A dropped worker's in-flight jobs are requeued by the
+        loader (failed-minibatch queue); their accounting must be
+        released too, or the epoch-boundary decision would wait on
+        updates that will never arrive.  If the dropped worker held
+        the epoch's LAST minibatch, the boundary is restored here —
+        the loader re-serves that batch with last_minibatch=False
+        (its metrics land in the successor bucket), so without this
+        the epoch would never close and training would run long."""
+        super(AcceleratedWorkflow, self).drop_slave(slave)
+        entries = list(self._inflight_by_slave_.get(slave, ()))
+        for key, was_last, epoch_ended in entries:
+            self._release_inflight(slave, key)
+            if was_last:
+                self._finish_pending_.setdefault(key, epoch_ended)
+            self._maybe_finish_remote(key)

@@ -13,8 +13,8 @@ import random
 import time
 
 from .logger import Logger
-from .network_common import (connect, machine_id, recv_message,
-                             send_message)
+from .network_common import (connect, machine_id, normalize_secret,
+                             recv_message, send_message)
 
 
 def measure_computing_power(repeats=2, n=1024):
@@ -48,6 +48,10 @@ class Client(Logger):
         self.poll_delay = kwargs.get("poll_delay", 0.05)
         self.power = kwargs.get("power") or 1.0
         self.measure_power = kwargs.get("measure_power", False)
+        #: shared-secret HMAC key for frame authentication (defaults
+        #: to the workflow checksum both sides already share).
+        self._secret = normalize_secret(
+            kwargs.get("secret") or workflow.checksum)
         self.id = None
         self.jobs_done = 0
         self._stop = False
@@ -95,9 +99,20 @@ class Client(Logger):
             "mid": machine_id(),
             "pid": os.getpid(),
             "power": self.power,
-        })
-        reply = recv_message(sock)
-        if not reply or reply.get("cmd") != "handshake_ack":
+        }, self._secret)
+        reply = recv_message(sock, self._secret)
+        if reply is None:
+            # With default keying (secret = workflow checksum) a
+            # version mismatch fails HMAC verification before the
+            # server can even read our checksum, so no error frame
+            # can come back — diagnose it here instead.
+            self.warning(
+                "handshake got no authenticated reply — likely a "
+                "workflow checksum/secret mismatch with the "
+                "coordinator (our checksum: %s)",
+                self.workflow.checksum)
+            return False
+        if reply.get("cmd") != "handshake_ack":
             self.warning("handshake rejected: %s", reply)
             return False
         self.id = reply["id"]
@@ -110,8 +125,8 @@ class Client(Logger):
     def _job_cycle(self, sock):
         """Returns True on orderly completion."""
         while not self._stop:
-            send_message(sock, {"cmd": "job_request"})
-            msg = recv_message(sock)
+            send_message(sock, {"cmd": "job_request"}, self._secret)
+            msg = recv_message(sock, self._secret)
             if msg is None:
                 return False
             cmd = msg.get("cmd")
@@ -135,8 +150,8 @@ class Client(Logger):
             self.workflow.do_job(msg["data"], None, capture)
             self.jobs_done += 1
             send_message(sock, {"cmd": "update",
-                                "data": result.get("update")})
-            ack = recv_message(sock)
+                                "data": result.get("update")}, self._secret)
+            ack = recv_message(sock, self._secret)
             if ack is None:
                 return False
             if ack.get("cmd") == "bye":

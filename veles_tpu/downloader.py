@@ -58,6 +58,18 @@ class Downloader(Unit):
             os.remove(archive)
         elif zipfile.is_zipfile(archive):
             with zipfile.ZipFile(archive) as z:
+                # Zip-slip guard (the tar path gets this from
+                # filter="data"): refuse members that would resolve
+                # outside the target directory.
+                target = os.path.realpath(self.directory)
+                for info in z.infolist():
+                    dest = os.path.realpath(
+                        os.path.join(target, info.filename))
+                    if dest != target and not dest.startswith(
+                            target + os.sep):
+                        raise ValueError(
+                            "refusing to extract %r outside %s" %
+                            (info.filename, target))
                 z.extractall(self.directory)
             os.remove(archive)
         # plain files stay as downloaded

@@ -15,6 +15,8 @@ snappy/gzip/xz codecs, txzmq/connection.py:484-560).
 """
 
 import gzip
+import hashlib
+import hmac as hmac_mod
 import pickle
 import socket
 import struct
@@ -22,6 +24,7 @@ import uuid
 
 _HEADER = struct.Struct(">QB")  # payload length, flags
 _FLAG_GZIP = 1
+_DIGEST_SIZE = hashlib.sha256().digest_size
 
 #: Payloads above this size are compressed (control messages are tiny;
 #: index arrays for big blocks may not be).
@@ -45,8 +48,20 @@ def machine_id():
     return "%012x" % uuid.getnode()
 
 
-def send_message(sock, obj):
-    """Frames and sends one pickled message (blocking)."""
+def normalize_secret(secret):
+    """Caller convenience: str → bytes, None stays None."""
+    if secret is None:
+        return None
+    if isinstance(secret, str):
+        return secret.encode("utf-8")
+    return bytes(secret)
+
+
+def send_message(sock, obj, secret=None):
+    """Frames and sends one pickled message (blocking).  With
+    ``secret``, an HMAC-SHA256 over flags+body is prepended so the
+    peer can authenticate the frame BEFORE unpickling (pickle from an
+    unauthenticated peer is arbitrary code execution)."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     flags = 0
     if len(payload) >= COMPRESS_THRESHOLD:
@@ -54,11 +69,17 @@ def send_message(sock, obj):
         if len(packed) < len(payload):
             payload = packed
             flags |= _FLAG_GZIP
+    if secret is not None:
+        mac = hmac_mod.new(secret, bytes([flags]) + payload,
+                           hashlib.sha256).digest()
+        payload = mac + payload
     sock.sendall(_HEADER.pack(len(payload), flags) + payload)
 
 
-def recv_message(sock):
-    """Receives one framed message; None on orderly close."""
+def recv_message(sock, secret=None):
+    """Receives one framed message; None on orderly close or (with
+    ``secret``) on authentication failure — callers treat both as a
+    dead peer and drop the connection."""
     header = _recv_exact(sock, _HEADER.size)
     if header is None:
         return None
@@ -66,6 +87,15 @@ def recv_message(sock):
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
+    if secret is not None:
+        if len(payload) < _DIGEST_SIZE:
+            return None
+        mac, payload = (payload[:_DIGEST_SIZE],
+                        payload[_DIGEST_SIZE:])
+        want = hmac_mod.new(secret, bytes([flags]) + payload,
+                            hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(mac, want):
+            return None
     if flags & _FLAG_GZIP:
         payload = gzip.decompress(payload)
     return pickle.loads(payload)

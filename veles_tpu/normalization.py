@@ -136,9 +136,10 @@ class RangeLinearNormalizer(NormalizerBase):
 
 
 class MeanDispersionNormalizer(NormalizerBase):
-    """(x − mean) / dispersion with per-feature statistics accumulated
-    in streaming fashion (reference "mean_disp",
-    normalization.py:284)."""
+    """(x − mean) / (max − min) with per-feature statistics
+    accumulated in streaming fashion (reference "mean_disp",
+    normalization.py:284 — which documents that "disp" is the
+    max−min spread, NOT the statistical dispersion)."""
     MAPPING = "mean_disp"
 
     def _analyze(self, data):
@@ -146,18 +147,22 @@ class MeanDispersionNormalizer(NormalizerBase):
         s = self.state
         s.setdefault("n", 0)
         s.setdefault("sum", numpy.zeros(flat.shape[1]))
-        s.setdefault("sum2", numpy.zeros(flat.shape[1]))
         s["n"] += len(flat)
         s["sum"] += flat.sum(axis=0)
-        s["sum2"] += (flat * flat).sum(axis=0)
+        mn = flat.min(axis=0)
+        mx = flat.max(axis=0)
+        if "min" in s:
+            mn = numpy.minimum(mn, s["min"])
+            mx = numpy.maximum(mx, s["max"])
+        s["min"] = mn
+        s["max"] = mx
         s["shape"] = data.shape[1:]
 
     def _stats(self):
         s = self.state
         mean = s["sum"] / s["n"]
-        disp = numpy.sqrt(numpy.maximum(
-            s["sum2"] / s["n"] - mean * mean, 0.0))
-        disp = numpy.maximum(disp, 1e-8)
+        disp = s["max"] - s["min"]
+        disp[disp == 0] = 1.0
         shape = tuple(s["shape"])
         return (mean.reshape(shape).astype(numpy.float32),
                 disp.reshape(shape).astype(numpy.float32))

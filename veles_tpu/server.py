@@ -29,8 +29,8 @@ import threading
 import time
 
 from .logger import Logger
-from .network_common import (machine_id, parse_address, recv_message,
-                             send_message)
+from .network_common import (machine_id, normalize_secret,
+                             parse_address, recv_message, send_message)
 
 
 class SlaveDescription(object):
@@ -69,12 +69,22 @@ class Server(Logger):
         self._slave_seq = 0
         self._stop = threading.Event()
         self.on_stopped = kwargs.get("on_stopped")
+        #: frames are HMAC-authenticated before unpickling; the
+        #: default key is the workflow checksum, which legitimate
+        #: workers already share (they run the same workflow source).
+        self._secret = normalize_secret(
+            kwargs.get("secret") or workflow.checksum)
         #: jobs handed out but not yet answered, per slave id
         self._outstanding = {}
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name="veles-server-accept")
         self._accept_thread.start()
+        self._watchdog_interval = kwargs.get("watchdog_interval", 1.0)
+        self._watchdog_thread = threading.Thread(
+            target=self._watchdog_loop, daemon=True,
+            name="veles-server-watchdog")
+        self._watchdog_thread.start()
         self.info("coordinator listening on %s:%d", self.host,
                   self.port)
 
@@ -116,15 +126,38 @@ class Server(Logger):
 
     def _blacklist_check(self, desc):
         """Adaptive job timeout: mean+3σ of this worker's history
-        (reference: server.py:619-635)."""
-        if len(desc.job_times) < 4 or desc.job_started is None:
+        (reference: server.py:619-635).  ``job_started`` is read once
+        — a handler thread may null it concurrently."""
+        started = desc.job_started
+        times = list(desc.job_times)
+        if len(times) < 4 or started is None:
             return False
-        mean = statistics.mean(desc.job_times)
-        sigma = statistics.pstdev(desc.job_times)
-        if time.time() - desc.job_started > mean + 3 * sigma + 1.0:
+        mean = statistics.mean(times)
+        sigma = statistics.pstdev(times)
+        if time.time() - started > mean + 3 * sigma + 1.0:
             desc.blacklisted = True
             return True
         return False
+
+    def _watchdog_loop(self):
+        """Periodic sweep firing the adaptive timeout: a hung worker
+        is blacklisted and its in-flight work requeued — the
+        reference's job-timeout dropper (server.py:619-635) made
+        periodic instead of waiting for the TCP connection to die.
+        The whole sweep runs under the workflow lock so it cannot
+        interleave with an update being applied for the same job."""
+        while not self._stop.wait(self._watchdog_interval):
+            with self._lock:
+                for desc in list(self._slaves.values()):
+                    if desc.blacklisted or desc.state != "WORK":
+                        continue
+                    if self._blacklist_check(desc):
+                        self.warning(
+                            "worker %s exceeded adaptive job timeout "
+                            "— blacklisted, requeueing its work",
+                            desc.id)
+                        self._outstanding.pop(desc.id, None)
+                        self.workflow.drop_slave(desc.id)
 
     # -- protocol ----------------------------------------------------------
 
@@ -142,7 +175,7 @@ class Server(Logger):
     def _serve_slave(self, conn, addr):
         desc = None
         try:
-            hello = recv_message(conn)
+            hello = recv_message(conn, self._secret)
             if not hello or hello.get("cmd") != "handshake":
                 return
             # Checksum verification (reference: server.py:484-493).
@@ -151,7 +184,7 @@ class Server(Logger):
             if theirs != ours:
                 send_message(conn, {"cmd": "error",
                                     "error": "checksum mismatch",
-                                    "expected": ours})
+                                    "expected": ours}, self._secret)
                 return
             with self._lock:
                 self._slave_seq += 1
@@ -164,7 +197,7 @@ class Server(Logger):
                 initial = self.workflow.\
                     generate_initial_data_for_slave(sid)
             send_message(conn, {"cmd": "handshake_ack", "id": sid,
-                                "initial": initial})
+                                "initial": initial}, self._secret)
             self.info("worker %s joined (power %.1f)", sid,
                       desc.power)
             self._message_loop(conn, desc)
@@ -178,31 +211,31 @@ class Server(Logger):
 
     def _message_loop(self, conn, desc):
         while not self._stop.is_set():
-            msg = recv_message(conn)
+            msg = recv_message(conn, self._secret)
             if msg is None:
                 return
             cmd = msg.get("cmd")
             if cmd == "job_request":
                 if desc.paused or desc.blacklisted:
                     send_message(conn, {"cmd": "no_job",
-                                        "retry": True})
+                                        "retry": True}, self._secret)
                     continue
                 job = self._generate_job(desc)
                 if job is None:
                     if self._maybe_finished():
-                        send_message(conn, {"cmd": "bye"})
+                        send_message(conn, {"cmd": "bye"}, self._secret)
                         return
                     send_message(conn, {"cmd": "no_job",
-                                        "retry": True})
+                                        "retry": True}, self._secret)
                 else:
                     desc.state = "WORK"
                     desc.job_started = time.time()
-                    send_message(conn, {"cmd": "job", "data": job})
+                    send_message(conn, {"cmd": "job", "data": job}, self._secret)
             elif cmd == "update":
                 self._apply_update(desc, msg["data"])
-                send_message(conn, {"cmd": "update_ack"})
+                send_message(conn, {"cmd": "update_ack"}, self._secret)
                 if self._maybe_finished():
-                    send_message(conn, {"cmd": "bye"})
+                    send_message(conn, {"cmd": "bye"}, self._secret)
                     return
             elif cmd == "bye":
                 return
@@ -221,7 +254,14 @@ class Server(Logger):
             return data
 
     def _apply_update(self, desc, data):
+        """Returns False when the update was discarded.  The
+        blacklist re-check happens UNDER the lock: the watchdog may
+        have blacklisted this worker (and requeued its job) between
+        the handler reading the frame and getting here — applying
+        the late result then would double-count the batch."""
         with self._lock:
+            if desc.blacklisted:
+                return False
             self.workflow.apply_data_from_slave(data, desc.id)
             desc.state = "WAIT"
             desc.jobs_done += 1
@@ -233,6 +273,7 @@ class Server(Logger):
                 self._outstanding.pop(desc.id, None)
             else:
                 self._outstanding[desc.id] = n - 1
+            return True
 
     def _finished_locked(self):
         stop = getattr(self.workflow, "should_stop_serving", None)

@@ -108,15 +108,20 @@ class DecisionGD(DecisionBase, IResultProvider):
         super(DecisionGD, self).init_unpickled()
         self._remote_acc_ = {}
 
-    def accumulate_remote(self, cls, metrics):
-        acc = self._remote_acc_.setdefault(cls, [0.0, 0.0, 0.0, 0.0])
+    def accumulate_remote(self, cls, metrics, epoch=None):
+        """Buckets are keyed by (epoch, cls): with several workers,
+        jobs from epoch N+1 start flowing before every epoch-N update
+        has landed, and a flat per-class bucket would leak metrics
+        across the boundary (skewing per-epoch error accounting)."""
+        acc = self._remote_acc_.setdefault(
+            (epoch, cls), [0.0, 0.0, 0.0, 0.0])
         acc[0] += float(metrics.get("n_err", 0.0))
         acc[1] += float(metrics.get("n_valid", 0.0))
         acc[2] += float(metrics.get("loss", 0.0))
         acc[3] += 1.0
 
-    def finish_remote_class(self, cls):
-        acc = self._remote_acc_.pop(cls, None)
+    def finish_remote_class(self, cls, epoch=None):
+        acc = self._remote_acc_.pop((epoch, cls), None)
         if acc is None:
             return
         self.epoch_n_err[cls] = acc[0]
