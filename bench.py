@@ -28,10 +28,20 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 A100_ALEXNET_IMG_PER_SEC = 10000.0
 A100_MLP_IMG_PER_SEC = 1.5e6
 
-ALEXNET_BATCH = 256
-ALEXNET_TICKS_PER_DISPATCH = 8
-ALEXNET_N_TRAIN = 4096
-ALEXNET_N_VALID = 256
+# Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
+# or blocks gain <3% more.  The perf levers that got here: banded-
+# matmul LRN (~2× over shifted adds), bf16 activation stream, and
+# unpadded partial blocks (validation used to burn a full block).
+ALEXNET_BATCH = 512
+ALEXNET_TICKS_PER_DISPATCH = 32
+ALEXNET_N_TRAIN = 16384
+ALEXNET_N_VALID = 512
+
+#: Analytic AlexNet training cost (fwd conv+FC MACs ×2 FLOP ×3 for
+#: fwd+bwd+wgrad at 227px/1000 classes ≈ 0.72 GMAC fwd) — used only
+#: for the reported TFLOP/s / MFU diagnostics.
+ALEXNET_TRAIN_GFLOP_PER_IMG = 4.33
+TPU_V5E_PEAK_BF16_TFLOPS = 197.0
 
 MLP_BATCH = 100
 MLP_TICKS_PER_DISPATCH = 120
@@ -130,11 +140,15 @@ def main():
         return
     _, wf = build_alexnet()
     ips = measure(wf, epochs=2)
+    tflops = ips * ALEXNET_TRAIN_GFLOP_PER_IMG / 1000.0
     print(json.dumps({
         "metric": "alexnet_train_images_per_sec",
         "value": round(ips, 1),
         "unit": "images/sec",
         "vs_baseline": round(ips / A100_ALEXNET_IMG_PER_SEC, 4),
+        "model_tflops_per_sec": round(tflops, 1),
+        "mfu_vs_v5e_bf16_peak": round(
+            tflops / TPU_V5E_PEAK_BF16_TFLOPS, 4),
     }))
 
 

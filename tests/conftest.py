@@ -35,3 +35,18 @@ def _reset_prng():
     prng.reset()
     yield
     prng.reset()
+
+
+@pytest.fixture
+def f32_precision():
+    """Pins the activation stream to f32 (precision_level 1) for
+    closed-form math tests whose tolerances bf16 cannot meet; the
+    default (level 0 = bf16 activations) is restored afterwards."""
+    from veles_tpu.config import root
+    prev = getattr(root.common.engine, "precision_level", None)
+    root.common.engine.precision_level = 1
+    yield
+    if prev is None:
+        root.common.engine.precision_level = 0
+    else:
+        root.common.engine.precision_level = prev

@@ -17,7 +17,7 @@ from veles_tpu.mean_disp_normalizer import MeanDispNormalizer
 from veles_tpu.znicz.samples.imagenet import AlexNetWorkflow
 
 
-def test_mean_disp_normalizer_unit():
+def test_mean_disp_normalizer_unit(f32_precision):
     wf = DummyWorkflow()
     unit = MeanDispNormalizer(wf)
     rng = numpy.random.RandomState(0)

@@ -81,6 +81,17 @@ class StepContext(object):
         self.loss = value
 
 
+def step_compute_dtype():
+    """Activation-stream dtype for the fused step: bf16 when
+    ``root.common.engine.precision_level`` is 0 (default), f32 above
+    (replaces the reference's OpenCL precision defines,
+    config.py:244-247).  Single source of truth — layer units and the
+    mean-disp normalizer all consult this."""
+    import jax.numpy as jnp
+    level = config_get(root.common.engine.precision_level, 0)
+    return jnp.bfloat16 if level == 0 else jnp.float32
+
+
 def select_by_training(ctx, train_fn, eval_fn):
     """Train/eval branch select that works in BOTH step modes: with a
     static Python bool (single-tick steps) it evaluates only the taken

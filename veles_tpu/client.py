@@ -48,10 +48,14 @@ class Client(Logger):
         self.poll_delay = kwargs.get("poll_delay", 0.05)
         self.power = kwargs.get("power") or 1.0
         self.measure_power = kwargs.get("measure_power", False)
-        #: shared-secret HMAC key for frame authentication (defaults
-        #: to the workflow checksum both sides already share).
+        #: Shared-secret HMAC key for frame authentication.  Same
+        #: precedence as the server: kwarg > VELES_NETWORK_SECRET env
+        #: > workflow checksum (the checksum default blocks stray
+        #: peers, not an attacker who has the workflow source).
         self._secret = normalize_secret(
-            kwargs.get("secret") or workflow.checksum)
+            kwargs.get("secret") or
+            os.environ.get("VELES_NETWORK_SECRET") or
+            workflow.checksum)
         self.id = None
         self.jobs_done = 0
         self._stop = False

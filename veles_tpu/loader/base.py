@@ -223,9 +223,13 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
     def serve_block(self, max_ticks):
         """Serves up to ``max_ticks`` consecutive minibatches of the
         SAME sample class (stopping at class boundaries so epoch flags
-        stay truthful), padded to exactly ``max_ticks`` with all-zero
-        masks.  Returns {vector_id: (max_ticks, ...) array} for the
-        block executor."""
+        stay truthful).  Returns {vector_id: (K, ...) array} with K =
+        ticks actually served — NOT padded: jit specializes the block
+        program per distinct K (a handful per run: the full block, the
+        train remainder, the validation remainder), which beats
+        burning a full block of conv compute on all-zero masks (a
+        256-sample validation pass used to cost as much as a
+        ticks_per_dispatch×batch training block)."""
         idxs, masks = [], []
         cls = None
         for _ in range(max_ticks):
@@ -246,13 +250,7 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             if self.last_minibatch or self.failed_minibatches:
                 break
         served = len(idxs)
-        pad = max_ticks - served
-        if pad:
-            zero_i = numpy.zeros_like(idxs[0])
-            zero_m = numpy.zeros_like(masks[0])
-            idxs.extend([zero_i] * pad)
-            masks.extend([zero_m] * pad)
-        cls_arr = numpy.full(max_ticks, self.minibatch_class,
+        cls_arr = numpy.full(served, self.minibatch_class,
                              dtype=numpy.int32)
         return {
             str(id(self.minibatch_indices)): numpy.stack(idxs),

@@ -47,9 +47,18 @@ class MeanDispNormalizer(TracedUnit):
         return [v for v in (self.mean, self.rdisp)
                 if isinstance(v, Vector)]
 
+    @property
+    def compute_dtype(self):
+        """Activation-stream dtype (same switch as the layer units)."""
+        from .accelerated_units import step_compute_dtype
+        return step_compute_dtype()
+
     def tforward(self, read, write, params, ctx, state=None):
         import jax.numpy as jnp
         x = read(self.input).astype(jnp.float32)
         mean = read(self.mean).astype(jnp.float32)
         rdisp = read(self.rdisp).astype(jnp.float32)
-        write(self.output, (x - mean) * rdisp)
+        # The normalized image enters the conv stack in the compute
+        # dtype so the first conv's input traffic is already narrow.
+        write(self.output,
+              ((x - mean) * rdisp).astype(self.compute_dtype))

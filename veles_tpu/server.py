@@ -69,11 +69,17 @@ class Server(Logger):
         self._slave_seq = 0
         self._stop = threading.Event()
         self.on_stopped = kwargs.get("on_stopped")
-        #: frames are HMAC-authenticated before unpickling; the
-        #: default key is the workflow checksum, which legitimate
-        #: workers already share (they run the same workflow source).
+        #: Frames are HMAC-authenticated before unpickling.  Key
+        #: precedence: explicit kwarg > VELES_NETWORK_SECRET env >
+        #: workflow checksum.  The checksum default stops stray/
+        #: accidental peers and version mismatches, but it is derived
+        #: from the workflow source — anyone who has the source can
+        #: compute it, so set a real secret on untrusted networks.
+        import os as _os
         self._secret = normalize_secret(
-            kwargs.get("secret") or workflow.checksum)
+            kwargs.get("secret") or
+            _os.environ.get("VELES_NETWORK_SECRET") or
+            workflow.checksum)
         #: jobs handed out but not yet answered, per slave id
         self._outstanding = {}
         self._accept_thread = threading.Thread(

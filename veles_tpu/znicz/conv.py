@@ -94,21 +94,23 @@ class Conv(ForwardBase):
         return v
 
     def tforward(self, read, write, params, ctx, state=None):
-        import jax.numpy as jnp
         from jax import lax
-        x = read(self.input)
-        w = params["weights"]
-        # f32 operands + DEFAULT precision: XLA runs the MXU in bf16
-        # passes with f32 accumulation on TPU (casting operands to
-        # bf16 manually breaks the conv transpose rule under autodiff,
-        # which requires matching dtypes).
+        cdt = self.compute_dtype
+        # Params live in f32 (the optimizer updates them there); the
+        # conv itself runs with bf16 operands by default so the
+        # activation stream between layers stays narrow — HBM
+        # bandwidth, not MXU FLOPs, bounds the conv stack on v5e.
+        # Matching operand dtypes keep the conv transpose rule happy
+        # under autodiff.
+        x = read(self.input).astype(cdt)
+        w = params["weights"].astype(cdt)
         y = lax.conv_general_dilated(
-            x.astype(jnp.float32), w.astype(jnp.float32),
+            x, w,
             window_strides=self.sliding,
             padding=self.padding,
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         if self.include_bias:
-            y = y + params["bias"]
+            y = y + params["bias"].astype(cdt)
         write(self.output, self.activation(y))
 
 
@@ -180,8 +182,9 @@ class Deconv(ForwardBase):
         import jax
         import jax.numpy as jnp
         from jax import lax
-        x = read(self.input).astype(jnp.float32)
-        w = read(self.conv.weights).astype(jnp.float32)
+        cdt = self.compute_dtype
+        x = read(self.input).astype(cdt)
+        w = read(self.conv.weights).astype(cdt)
         conv = self.conv
         in_shape = (x.shape[0],) + tuple(conv.input.shape[1:])
 

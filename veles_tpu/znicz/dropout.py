@@ -37,13 +37,12 @@ class DropoutForward(ForwardBase):
 
     def tforward(self, read, write, params, ctx, state=None):
         import jax
-        import jax.numpy as jnp
-        x = read(self.input).astype(jnp.float32)
+        x = read(self.input)  # keeps the activation dtype
         keep = 1.0 - self.dropout_ratio
 
         def train_branch():
             mask = jax.random.bernoulli(ctx.next_key(), keep, x.shape)
-            return x * mask / keep
+            return x * mask.astype(x.dtype) * (1.0 / keep)
 
         write(self.output, select_by_training(ctx, train_branch,
                                               lambda: x))

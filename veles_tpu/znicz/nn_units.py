@@ -106,11 +106,10 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
 
     @property
     def compute_dtype(self):
-        """bf16 when precision_level==0, f32 otherwise (replaces the
-        reference's OpenCL precision defines, config.py:244-247)."""
-        level = config_get(root.common.engine.precision_level, 0)
-        import jax.numpy as jnp
-        return jnp.bfloat16 if level == 0 else jnp.float32
+        """Activation-stream dtype (see
+        accelerated_units.step_compute_dtype)."""
+        from ..accelerated_units import step_compute_dtype
+        return step_compute_dtype()
 
     def rand(self):
         return prng.get(self.prng_key)

@@ -19,6 +19,14 @@ from .nn_units import ForwardBase
 from .conv import _norm_padding, _norm_sliding
 
 
+def _typed_inf(dtype, sign):
+    """±inf as a scalar of ``dtype`` — reduce_window only specializes
+    to its differentiable max/min form when the init value is the
+    dtype's own identity."""
+    import numpy as np
+    return np.asarray(sign * np.inf, dtype=dtype)[()]
+
+
 class Pooling(ForwardBase):
     """Common geometry for pooling units."""
 
@@ -82,23 +90,23 @@ class MaxPooling(Pooling):
     def tforward(self, read, write, params, ctx, state=None):
         import jax.numpy as jnp
         from jax import lax
-        x = read(self.input).astype(jnp.float32)
+        x = read(self.input)  # pooling keeps the activation dtype
         _, in_h, in_w, _ = x.shape
         pad = self._window_padding(in_h, in_w)
         if self.ABS:
             # Signed value of the max-absolute element: take the max
             # over |x| and recover the sign via paired reductions.
             hi = lax.reduce_window(
-                x, -jnp.inf, lax.max, self._window_dims(),
-                self._window_strides(), pad)
+                x, _typed_inf(x.dtype, -1), lax.max,
+                self._window_dims(), self._window_strides(), pad)
             lo = lax.reduce_window(
-                x, jnp.inf, lax.min, self._window_dims(),
-                self._window_strides(), pad)
+                x, _typed_inf(x.dtype, +1), lax.min,
+                self._window_dims(), self._window_strides(), pad)
             y = jnp.where(-lo > hi, lo, hi)
         else:
             y = lax.reduce_window(
-                x, -jnp.inf, lax.max, self._window_dims(),
-                self._window_strides(), pad)
+                x, _typed_inf(x.dtype, -1), lax.max,
+                self._window_dims(), self._window_strides(), pad)
         write(self.output, y)
 
 
@@ -115,18 +123,21 @@ class AvgPooling(Pooling):
     def tforward(self, read, write, params, ctx, state=None):
         import jax.numpy as jnp
         from jax import lax
-        x = read(self.input).astype(jnp.float32)
+        x = read(self.input)
+        # Accumulate in f32 even on a bf16 activation stream — a
+        # windowed bf16 sum rounds every partial to 8 mantissa bits.
+        x32 = x.astype(jnp.float32)
         _, in_h, in_w, _ = x.shape
         pad = self._window_padding(in_h, in_w)
         ssum = lax.reduce_window(
-            x, 0.0, lax.add, self._window_dims(),
+            x32, 0.0, lax.add, self._window_dims(),
             self._window_strides(), pad)
         # Divide by the true (unpadded) window population.
-        ones = jnp.ones_like(x)
+        ones = jnp.ones_like(x32)
         count = lax.reduce_window(
             ones, 0.0, lax.add, self._window_dims(),
             self._window_strides(), pad)
-        write(self.output, ssum / count)
+        write(self.output, (ssum / count).astype(x.dtype))
 
 
 class StochasticPooling(Pooling):
