@@ -13,8 +13,8 @@ import random
 import time
 
 from .logger import Logger
-from .network_common import (connect, machine_id, normalize_secret,
-                             recv_message, send_message)
+from .network_common import (Channel, connect, machine_id,
+                             normalize_secret)
 
 
 def measure_computing_power(repeats=2, n=1024):
@@ -74,37 +74,35 @@ class Client(Logger):
                 attempts += 1
                 time.sleep(self.reconnect_delay * attempts)
                 continue
+            chan = Channel(sock, self._secret)
             try:
-                if not self._handshake(sock):
+                if not self._handshake(chan):
                     attempts += 1
                     time.sleep(self.reconnect_delay * attempts)
                     continue
                 attempts = 0
-                if self._job_cycle(sock):
+                if self._job_cycle(chan):
                     return  # orderly bye
             except (OSError, ConnectionError):
                 pass
             finally:
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+                chan.close()
             attempts += 1
             time.sleep(self.reconnect_delay * attempts)
 
     # -- phases ------------------------------------------------------------
 
-    def _handshake(self, sock):
+    def _handshake(self, chan):
         if self.measure_power:
             self.power = measure_computing_power()
-        send_message(sock, {
+        chan.send({
             "cmd": "handshake",
             "checksum": self.workflow.checksum,
             "mid": machine_id(),
             "pid": os.getpid(),
             "power": self.power,
-        }, self._secret)
-        reply = recv_message(sock, self._secret)
+        })
+        reply = chan.recv()
         if reply is None:
             # With default keying (secret = workflow checksum) a
             # version mismatch fails HMAC verification before the
@@ -120,17 +118,20 @@ class Client(Logger):
             self.warning("handshake rejected: %s", reply)
             return False
         self.id = reply["id"]
+        # Session nonce: every later frame is MAC-bound to it
+        # (see network_common.Channel).
+        chan.rekey(reply.get("nonce", b""))
         initial = reply.get("initial")
         if initial:
             self.workflow.apply_data_from_master(initial)
         self.info("joined as %s", self.id)
         return True
 
-    def _job_cycle(self, sock):
+    def _job_cycle(self, chan):
         """Returns True on orderly completion."""
         while not self._stop:
-            send_message(sock, {"cmd": "job_request"}, self._secret)
-            msg = recv_message(sock, self._secret)
+            chan.send({"cmd": "job_request"})
+            msg = chan.recv()
             if msg is None:
                 return False
             cmd = msg.get("cmd")
@@ -153,9 +154,9 @@ class Client(Logger):
 
             self.workflow.do_job(msg["data"], None, capture)
             self.jobs_done += 1
-            send_message(sock, {"cmd": "update",
-                                "data": result.get("update")}, self._secret)
-            ack = recv_message(sock, self._secret)
+            chan.send({"cmd": "update",
+                       "data": result.get("update")})
+            ack = chan.recv()
             if ack is None:
                 return False
             if ack.get("cmd") == "bye":

@@ -57,11 +57,19 @@ def normalize_secret(secret):
     return bytes(secret)
 
 
-def send_message(sock, obj, secret=None):
+def _mac_input(flags, payload, nonce, seq):
+    """The authenticated bytes: per-connection nonce + monotonic
+    sequence + flags + body.  The nonce kills cross-session replay,
+    the sequence kills in-session replay/reorder."""
+    seq_bytes = b"" if seq is None else struct.pack(">Q", seq)
+    return nonce + seq_bytes + bytes([flags]) + payload
+
+
+def send_message(sock, obj, secret=None, nonce=b"", seq=None):
     """Frames and sends one pickled message (blocking).  With
-    ``secret``, an HMAC-SHA256 over flags+body is prepended so the
-    peer can authenticate the frame BEFORE unpickling (pickle from an
-    unauthenticated peer is arbitrary code execution)."""
+    ``secret``, an HMAC-SHA256 over nonce+seq+flags+body is prepended
+    so the peer can authenticate the frame BEFORE unpickling (pickle
+    from an unauthenticated peer is arbitrary code execution)."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     flags = 0
     if len(payload) >= COMPRESS_THRESHOLD:
@@ -70,16 +78,18 @@ def send_message(sock, obj, secret=None):
             payload = packed
             flags |= _FLAG_GZIP
     if secret is not None:
-        mac = hmac_mod.new(secret, bytes([flags]) + payload,
+        mac = hmac_mod.new(secret,
+                           _mac_input(flags, payload, nonce, seq),
                            hashlib.sha256).digest()
         payload = mac + payload
     sock.sendall(_HEADER.pack(len(payload), flags) + payload)
 
 
-def recv_message(sock, secret=None):
+def recv_message(sock, secret=None, nonce=b"", seq=None):
     """Receives one framed message; None on orderly close or (with
     ``secret``) on authentication failure — callers treat both as a
-    dead peer and drop the connection."""
+    dead peer and drop the connection.  ``seq`` is the sequence number
+    the frame MUST carry (replayed or reordered frames fail the MAC)."""
     header = _recv_exact(sock, _HEADER.size)
     if header is None:
         return None
@@ -92,13 +102,55 @@ def recv_message(sock, secret=None):
             return None
         mac, payload = (payload[:_DIGEST_SIZE],
                         payload[_DIGEST_SIZE:])
-        want = hmac_mod.new(secret, bytes([flags]) + payload,
+        want = hmac_mod.new(secret,
+                            _mac_input(flags, payload, nonce, seq),
                             hashlib.sha256).digest()
         if not hmac_mod.compare_digest(mac, want):
             return None
     if flags & _FLAG_GZIP:
         payload = gzip.decompress(payload)
     return pickle.loads(payload)
+
+
+class Channel(object):
+    """A socket wrapper binding HMAC authentication to a
+    per-connection nonce and monotonic per-direction sequence numbers
+    (ADVICE r2: static-key HMAC alone permits replay of captured
+    frames).
+
+    Handshake contract: both sides start with ``nonce=b""`` and
+    sequence 0; the server issues ``os.urandom(16)`` in its
+    ``handshake_ack`` and both sides then :meth:`rekey` — every later
+    frame is MAC-bound to that session."""
+
+    def __init__(self, sock, secret=None):
+        self.sock = sock
+        self.secret = normalize_secret(secret)
+        self.nonce = b""
+        self.send_seq = 0
+        self.recv_seq = 0
+
+    def rekey(self, nonce):
+        self.nonce = nonce
+
+    def send(self, obj):
+        send_message(self.sock, obj, self.secret, nonce=self.nonce,
+                     seq=self.send_seq if self.secret else None)
+        if self.secret is not None:
+            self.send_seq += 1
+
+    def recv(self):
+        obj = recv_message(self.sock, self.secret, nonce=self.nonce,
+                           seq=self.recv_seq if self.secret else None)
+        if obj is not None and self.secret is not None:
+            self.recv_seq += 1
+        return obj
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
 
 
 def _recv_exact(sock, n):

@@ -23,14 +23,15 @@ reference's per-unit apply_data_from_slave aggregation point,
 workflow.py:518-535).
 """
 
+import os
 import socket
 import statistics
 import threading
 import time
 
 from .logger import Logger
-from .network_common import (machine_id, normalize_secret,
-                             parse_address, recv_message, send_message)
+from .network_common import (Channel, machine_id, normalize_secret,
+                             parse_address)
 
 
 class SlaveDescription(object):
@@ -75,10 +76,9 @@ class Server(Logger):
         #: accidental peers and version mismatches, but it is derived
         #: from the workflow source — anyone who has the source can
         #: compute it, so set a real secret on untrusted networks.
-        import os as _os
         self._secret = normalize_secret(
             kwargs.get("secret") or
-            _os.environ.get("VELES_NETWORK_SECRET") or
+            os.environ.get("VELES_NETWORK_SECRET") or
             workflow.checksum)
         #: jobs handed out but not yet answered, per slave id
         self._outstanding = {}
@@ -87,6 +87,11 @@ class Server(Logger):
             name="veles-server-accept")
         self._accept_thread.start()
         self._watchdog_interval = kwargs.get("watchdog_interval", 1.0)
+        #: Floor for the adaptive timeout (reference: server.py:624
+        #: floors it at a job_timeout defaulting to 2 minutes).  With
+        #: uniform job times σ≈0 and a bare mean+3σ would blacklist a
+        #: healthy worker on any transient stall.
+        self.job_timeout = float(kwargs.get("job_timeout", 120.0))
         self._watchdog_thread = threading.Thread(
             target=self._watchdog_loop, daemon=True,
             name="veles-server-watchdog")
@@ -131,16 +136,21 @@ class Server(Logger):
             self._slaves[sid].paused = False
 
     def _blacklist_check(self, desc):
-        """Adaptive job timeout: mean+3σ of this worker's history
-        (reference: server.py:619-635).  ``job_started`` is read once
+        """Adaptive job timeout: mean+3σ of this worker's history,
+        floored at ``job_timeout`` (reference: server.py:619-635 with
+        the 2-minute floor at :624).  ``job_started`` is read once
         — a handler thread may null it concurrently."""
         started = desc.job_started
         times = list(desc.job_times)
-        if len(times) < 4 or started is None:
+        if started is None:
             return False
-        mean = statistics.mean(times)
-        sigma = statistics.pstdev(times)
-        if time.time() - started > mean + 3 * sigma + 1.0:
+        if len(times) < 4:
+            threshold = self.job_timeout
+        else:
+            mean = statistics.mean(times)
+            sigma = statistics.pstdev(times)
+            threshold = max(mean + 3 * sigma + 1.0, self.job_timeout)
+        if time.time() - started > threshold:
             desc.blacklisted = True
             return True
         return False
@@ -180,17 +190,18 @@ class Server(Logger):
 
     def _serve_slave(self, conn, addr):
         desc = None
+        chan = Channel(conn, self._secret)
         try:
-            hello = recv_message(conn, self._secret)
+            hello = chan.recv()
             if not hello or hello.get("cmd") != "handshake":
                 return
             # Checksum verification (reference: server.py:484-493).
             theirs = hello.get("checksum")
             ours = self.workflow.checksum
             if theirs != ours:
-                send_message(conn, {"cmd": "error",
-                                    "error": "checksum mismatch",
-                                    "expected": ours}, self._secret)
+                chan.send({"cmd": "error",
+                           "error": "checksum mismatch",
+                           "expected": ours})
                 return
             with self._lock:
                 self._slave_seq += 1
@@ -202,46 +213,56 @@ class Server(Logger):
                 self._slaves[sid] = desc
                 initial = self.workflow.\
                     generate_initial_data_for_slave(sid)
-            send_message(conn, {"cmd": "handshake_ack", "id": sid,
-                                "initial": initial}, self._secret)
+            # Fresh session nonce: all post-handshake frames (both
+            # directions) are MAC-bound to it + a sequence number, so
+            # captured frames cannot be replayed into this or any
+            # other session (ADVICE r2).
+            nonce = os.urandom(16)
+            chan.send({"cmd": "handshake_ack", "id": sid,
+                       "nonce": nonce, "initial": initial})
+            chan.rekey(nonce)
             self.info("worker %s joined (power %.1f)", sid,
                       desc.power)
-            self._message_loop(conn, desc)
+            self._message_loop(chan, desc)
         finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+            chan.close()
             if desc is not None:
                 self._drop(desc)
 
-    def _message_loop(self, conn, desc):
+    def _message_loop(self, chan, desc):
         while not self._stop.is_set():
-            msg = recv_message(conn, self._secret)
+            msg = chan.recv()
             if msg is None:
                 return
             cmd = msg.get("cmd")
             if cmd == "job_request":
-                if desc.paused or desc.blacklisted:
-                    send_message(conn, {"cmd": "no_job",
-                                        "retry": True}, self._secret)
+                if desc.blacklisted:
+                    # A blacklisted worker is disconnected rather than
+                    # left spinning on no_job retries; its dead job was
+                    # already requeued by the watchdog.  Reconnecting
+                    # gives it a fresh id and a clean slate (the
+                    # reference dropped the connection outright,
+                    # server.py:630-635).
+                    chan.send({"cmd": "bye"})
+                    return
+                if desc.paused:
+                    chan.send({"cmd": "no_job", "retry": True})
                     continue
                 job = self._generate_job(desc)
                 if job is None:
                     if self._maybe_finished():
-                        send_message(conn, {"cmd": "bye"}, self._secret)
+                        chan.send({"cmd": "bye"})
                         return
-                    send_message(conn, {"cmd": "no_job",
-                                        "retry": True}, self._secret)
+                    chan.send({"cmd": "no_job", "retry": True})
                 else:
                     desc.state = "WORK"
                     desc.job_started = time.time()
-                    send_message(conn, {"cmd": "job", "data": job}, self._secret)
+                    chan.send({"cmd": "job", "data": job})
             elif cmd == "update":
                 self._apply_update(desc, msg["data"])
-                send_message(conn, {"cmd": "update_ack"}, self._secret)
+                chan.send({"cmd": "update_ack"})
                 if self._maybe_finished():
-                    send_message(conn, {"cmd": "bye"}, self._secret)
+                    chan.send({"cmd": "bye"})
                     return
             elif cmd == "bye":
                 return
