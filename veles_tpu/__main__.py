@@ -1,0 +1,319 @@
+"""velescli — the platform entry point.
+
+Capability parity with the reference entry point (reference:
+veles/__main__.py — ``Main:129``, module loading ``_load_model:389``,
+config application ``:419,467``, seeding ``_seed_random:476``, snapshot
+resume ``_load_workflow:532``, mode dispatch ``_run_core:710``, results
+``run:814``): loads a workflow module (a ``.py`` defining
+``run(load, main)``), layers config files and ``root.x=y`` overrides,
+resumes snapshots, seeds the deterministic PRNGs, dispatches regular /
+genetics / ensemble modes, and writes the ``--result-file`` metrics
+JSON.
+
+Usage::
+
+    python -m veles_tpu path/to/workflow.py [config.py ...] \
+        [root.x=y ...] [options]
+
+TPU-era notes: no Twisted reactor, no daemonization, no web-frontend
+wizard process handling here — the launcher owns lifecycle; the
+frontend generator lives in ``veles_tpu.scripts.generate_frontend``.
+"""
+
+import importlib
+import importlib.util
+import logging
+import os
+import sys
+import time
+
+from .cmdline import CommandLineBase, init_argparser
+from .config import root, get as config_get
+from .error import Bug
+from .json_encoders import dump_json
+from .launcher import Launcher
+from .logger import Logger
+from .snapshotter import SnapshotterToFile
+from . import prng
+
+
+def import_workflow_module(spec):
+    """Imports a workflow module from a ``.py`` path or a dotted name
+    (reference: __main__.py:389 ``_load_model``).
+
+    Path form: if the file sits inside a package (``__init__.py``
+    chain), its real dotted name is imported so relative imports work;
+    a bare file is exec'd under a synthetic module name.
+    """
+    if not spec.endswith(".py"):
+        return importlib.import_module(spec)
+    path = os.path.abspath(spec)
+    if not os.path.isfile(path):
+        raise FileNotFoundError("workflow module not found: %s" % spec)
+    # Walk up while __init__.py exists to recover the package name.
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    parent = os.path.dirname(path)
+    while os.path.isfile(os.path.join(parent, "__init__.py")):
+        parts.insert(0, os.path.basename(parent))
+        parent = os.path.dirname(parent)
+    if len(parts) > 1:
+        if parent not in sys.path:
+            sys.path.insert(0, parent)
+        return importlib.import_module(".".join(parts))
+    mod_name = "veles_tpu_workflow_" + parts[0]
+    spec_obj = importlib.util.spec_from_file_location(mod_name, path)
+    module = importlib.util.module_from_spec(spec_obj)
+    sys.modules[mod_name] = module
+    spec_obj.loader.exec_module(module)
+    return module
+
+
+def apply_config_sources(sources, logger=None):
+    """Applies config files and ``root.x=y`` override assignments in
+    order (reference: __main__.py:419,467)."""
+    for src in sources:
+        if "=" in src and src.lstrip().startswith("root."):
+            code = src
+            origin = "<override>"
+        elif os.path.isfile(src):
+            with open(src) as fin:
+                code = fin.read()
+            origin = src
+        else:
+            raise Bug("config source %r is neither a root.x=y override "
+                      "nor an existing file" % src)
+        if logger is not None:
+            logger.debug("applying config source %s", origin)
+        exec(compile(code, origin, "exec"),
+             {"root": root, "Tune": _tune_cls()})
+
+
+def _tune_cls():
+    from .config import Tune
+    return Tune
+
+
+class Main(Logger, CommandLineBase):
+    """The velescli driver (reference: __main__.py:129)."""
+
+    EXIT_SUCCESS = 0
+    EXIT_FAILURE = 1
+
+    def __init__(self, argv=None):
+        super(Main, self).__init__()
+        self.argv = list(sys.argv[1:] if argv is None else argv)
+        self.args = None
+        self.launcher = None
+        self.workflow = None
+        self.module = None
+        self._start_time = None
+        self._snapshot_loaded = False
+
+    # -- setup -------------------------------------------------------------
+
+    def parse(self):
+        parser = init_argparser(prog="veles_tpu")
+        self.args = parser.parse_args(self.argv)
+        level = {"debug": logging.DEBUG, "info": logging.INFO,
+                 "warning": logging.WARNING,
+                 "error": logging.ERROR}[self.args.verbosity]
+        logging.getLogger().setLevel(level)
+        return self.args
+
+    def seed_random(self):
+        """Seeds generator 0 from ``--random-seed`` (reference:
+        __main__.py:476-530): an int, or ``file:count:dtype``."""
+        spec = self.args.random_seed
+        if not spec:
+            return
+        try:
+            seed = int(spec)
+        except ValueError:
+            seed = spec  # file:count:dtype — RandomGenerator parses it
+        prng.get(0).seed(seed)
+        self.info("seeded PRNG 0 with %r", spec)
+
+    # -- workflow construction (the load/main closures) --------------------
+
+    def _launcher_kwargs(self):
+        kw = {}
+        if self.args.listen_address:
+            kw["listen_address"] = self.args.listen_address
+        if self.args.master_address:
+            kw["master_address"] = self.args.master_address
+        return kw
+
+    def load(self, WorkflowClass, **kwargs):
+        """``load`` closure passed to the module's run() hook
+        (reference: __main__.py:584 ``_load``): builds the launcher,
+        then either resumes a snapshot or constructs the workflow."""
+        kwargs.setdefault("result_file", self.args.result_file or None)
+        self.launcher = Launcher(**self._launcher_kwargs())
+        if self.args.snapshot:
+            self.workflow = SnapshotterToFile.import_(self.args.snapshot)
+            self._snapshot_loaded = True
+            self.launcher.add_ref(self.workflow)
+            self.info("resumed snapshot %s (%s)", self.args.snapshot,
+                      type(self.workflow).__name__)
+        else:
+            self.workflow = WorkflowClass(self.launcher, **kwargs)
+        if self.args.max_epochs:
+            decision = getattr(self.workflow, "decision", None)
+            if decision is None:
+                raise Bug("--max-epochs given but the workflow has no "
+                          "decision unit")
+            decision.max_epochs = int(self.args.max_epochs)
+        return self.workflow, self._snapshot_loaded
+
+    def main(self, **kwargs):
+        """``main`` closure passed to the module's run() hook
+        (reference: __main__.py:620 ``_main``): initialize → run →
+        results."""
+        if self.workflow is None:
+            raise Bug("main() called before load()")
+        if self.args.dry_run == "load":
+            return
+        if self.args.backend:
+            from .backends import Device
+            kwargs.setdefault("device",
+                              Device.create(self.args.backend))
+        self.launcher.initialize(
+            snapshot=self._snapshot_loaded, **kwargs)
+        if self.args.workflow_graph:
+            self.workflow.generate_graph(self.args.workflow_graph)
+            self.info("workflow graph -> %s", self.args.workflow_graph)
+        if self.args.dry_run == "init":
+            return
+        profile_dir = self.args.profile
+        if profile_dir:
+            import jax
+            jax.profiler.start_trace(profile_dir)
+        try:
+            self.launcher.run()
+        finally:
+            if profile_dir:
+                import jax
+                jax.profiler.stop_trace()
+                self.info("profiler trace -> %s", profile_dir)
+        if self.args.dry_run == "exec":
+            return
+        self.write_results()
+
+    def write_results(self):
+        """Serializes run metrics to ``--result-file`` (reference:
+        workflow.py:814-836 + __main__.py ``run``)."""
+        path = self.args.result_file
+        if not path:
+            return
+        results = {
+            "workflow": self.workflow.name,
+            "class": type(self.workflow).__name__,
+            "checksum": self.workflow.checksum,
+            "mode": self.launcher.mode,
+            "seed": repr(prng.get(0).seed_value),
+            "runtime": self.launcher.runtime,
+            "units": len(self.workflow.units),
+            "results": self.workflow.gather_results(),
+        }
+        dump_json(results, path)
+        self.info("results -> %s", path)
+
+    # -- mode dispatch ------------------------------------------------------
+
+    def run_regular(self):
+        run_hook = getattr(self.module, "run", None)
+        if run_hook is None:
+            raise Bug("workflow module %s has no run(load, main) hook"
+                      % self.module.__name__)
+        run_hook(self.load, self.main)
+
+    def run_genetics(self):
+        from .genetics.optimizer import GeneticsOptimizer
+        size_spec = self.args.optimize
+        if ":" in size_spec:
+            size, generations = (int(p) for p in size_spec.split(":"))
+        else:
+            size, generations = int(size_spec), None
+        optimizer = GeneticsOptimizer(
+            main=self, size=size, generations=generations)
+        optimizer.run()
+
+    def run_ensemble_train(self):
+        from .ensemble import EnsembleTrainer
+        spec = self.args.ensemble_train
+        if ":" in spec:
+            n, ratio = spec.split(":", 1)
+            n, ratio = int(n), float(ratio)
+        else:
+            n, ratio = int(spec), 1.0
+        EnsembleTrainer(main=self, instances=n,
+                        train_ratio=ratio).run()
+
+    def run_ensemble_test(self):
+        from .ensemble import EnsembleTester
+        EnsembleTester(main=self,
+                       ensemble_file=self.args.ensemble_test).run()
+
+    # -- top-level ----------------------------------------------------------
+
+    def run(self):
+        self._start_time = time.time()
+        self.parse()
+        if not self.args.workflow:
+            init_argparser(prog="veles_tpu").print_help()
+            return self.EXIT_FAILURE
+        try:
+            self.seed_random()
+            apply_config_sources(
+                list(self.args.config) + list(self.args.config_list),
+                logger=self)
+            self.module = import_workflow_module(self.args.workflow)
+            if self.args.dump_config:
+                root.print_()
+            if self.args.optimize:
+                self.run_genetics()
+            elif self.args.ensemble_train:
+                self.run_ensemble_train()
+            elif self.args.ensemble_test:
+                self.run_ensemble_test()
+            else:
+                self.run_regular()
+        except KeyboardInterrupt:
+            self.warning("interrupted")
+            if self.launcher is not None:
+                self.launcher.stop()
+            return self.EXIT_FAILURE
+        except Exception:
+            self.exception("workflow run failed")
+            return self.EXIT_FAILURE
+        self._report_resources()
+        return self.EXIT_SUCCESS
+
+    def _report_resources(self):
+        """Peak RSS + device memory at exit (reference:
+        __main__.py:785-791)."""
+        try:
+            import resource
+            peak_kb = resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss
+            self.info("peak RSS: %.1f MB; wall time: %.1fs",
+                      peak_kb / 1024.0,
+                      time.time() - self._start_time)
+        except Exception:
+            pass
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats()
+            if stats and "peak_bytes_in_use" in stats:
+                self.info("peak device memory: %.1f MB",
+                          stats["peak_bytes_in_use"] / 1e6)
+        except Exception:
+            pass
+
+
+def main(argv=None):
+    return Main(argv).run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

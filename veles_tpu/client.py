@@ -118,9 +118,16 @@ class Client(Logger):
             self.warning("handshake rejected: %s", reply)
             return False
         self.id = reply["id"]
-        # Session nonce: every later frame is MAC-bound to it
-        # (see network_common.Channel).
-        chan.rekey(reply.get("nonce", b""))
+        # Session nonce: every later frame is MAC-bound to it (see
+        # network_common.Channel).  A missing nonce means a peer that
+        # cannot provide replay protection — hard-fail rather than
+        # silently continuing on static keying.
+        nonce = reply.get("nonce")
+        if not nonce:
+            self.warning("handshake_ack carried no session nonce — "
+                         "refusing the session")
+            return False
+        chan.rekey(nonce)
         initial = reply.get("initial")
         if initial:
             self.workflow.apply_data_from_master(initial)

@@ -1,0 +1,43 @@
+"""numpy-aware JSON encoding (reference: veles/json_encoders.py).
+
+Used by ``--result-file`` output, ensembles, and the web status
+server — run metrics routinely contain numpy scalars/arrays and jax
+device scalars that the stdlib encoder rejects.
+"""
+
+import json
+
+import numpy
+
+
+class NumpyJSONEncoder(json.JSONEncoder):
+    def default(self, obj):
+        if isinstance(obj, numpy.integer):
+            return int(obj)
+        if isinstance(obj, numpy.floating):
+            return float(obj)
+        if isinstance(obj, numpy.bool_):
+            return bool(obj)
+        if isinstance(obj, numpy.ndarray):
+            return obj.tolist()
+        if hasattr(obj, "item") and not isinstance(obj, (str, bytes)):
+            # jax.Array scalars and 0-d arrays.
+            try:
+                return obj.item()
+            except Exception:
+                pass
+        if isinstance(obj, (set, frozenset)):
+            return sorted(obj)
+        return super(NumpyJSONEncoder, self).default(obj)
+
+
+def dump_json(obj, path, **kwargs):
+    kwargs.setdefault("indent", 2)
+    kwargs.setdefault("sort_keys", True)
+    with open(path, "w") as fout:
+        json.dump(obj, fout, cls=NumpyJSONEncoder, **kwargs)
+        fout.write("\n")
+
+
+def dumps_json(obj, **kwargs):
+    return json.dumps(obj, cls=NumpyJSONEncoder, **kwargs)

@@ -49,12 +49,15 @@ def machine_id():
 
 
 def normalize_secret(secret):
-    """Caller convenience: str → bytes, None stays None."""
+    """Caller convenience: str → bytes; None and EMPTY both mean "no
+    auth" (an empty key would MAC frames yet skip the truthiness-gated
+    sequence binding — half-authenticated is worse than unauthenticated
+    because it looks secure)."""
     if secret is None:
         return None
     if isinstance(secret, str):
-        return secret.encode("utf-8")
-    return bytes(secret)
+        secret = secret.encode("utf-8")
+    return bytes(secret) or None
 
 
 def _mac_input(flags, payload, nonce, seq):

@@ -239,11 +239,12 @@ class Server(Logger):
                 if desc.blacklisted:
                     # A blacklisted worker is disconnected rather than
                     # left spinning on no_job retries; its dead job was
-                    # already requeued by the watchdog.  Reconnecting
-                    # gives it a fresh id and a clean slate (the
-                    # reference dropped the connection outright,
-                    # server.py:630-635).
-                    chan.send({"cmd": "bye"})
+                    # already requeued by the watchdog.  The connection
+                    # is dropped WITHOUT a "bye" (which would read as
+                    # orderly completion and retire the worker):
+                    # recv()→None makes the client reconnect with a
+                    # fresh id and a clean slate (the reference dropped
+                    # the connection outright, server.py:630-635).
                     return
                 if desc.paused:
                     chan.send({"cmd": "no_job", "retry": True})
