@@ -1,0 +1,84 @@
+"""Ensemble train/test round-trip (reference capability:
+veles/ensemble/{base,model,test}_workflow.py via --ensemble-train /
+--ensemble-test)."""
+
+import json
+import os
+
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.config import root
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MNIST = os.path.join(REPO, "veles_tpu", "znicz", "samples", "mnist.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    root.mnist.reset()
+    yield
+    root.mnist.reset()
+    root.common.loader.train_ratio = 1.0
+
+
+def test_ensemble_round_trip(tmp_path):
+    from veles_tpu.__main__ import Main
+
+    ens_file = tmp_path / "ens.json"
+    prng.reset()
+    rc = Main([MNIST, "root.mnist.max_epochs=4",
+               "root.mnist.learning_rate=0.1",
+               "--ensemble-train", "3:0.8",
+               "--result-file", str(ens_file),
+               "--random-seed", "77", "-v", "warning"]).run()
+    assert rc == 0
+    desc = json.loads(ens_file.read_text())
+    assert desc["mode"] == "ensemble-train"
+    assert desc["size"] == 3
+    assert len(desc["instances"]) == 3
+    seeds = {inst["seed"] for inst in desc["instances"]}
+    assert len(seeds) == 3  # varied seeds
+    for inst in desc["instances"]:
+        assert os.path.isfile(inst["snapshot"])
+        assert inst["fitness"] > 0.7
+        assert inst["train_ratio"] == 0.8
+
+    test_file = tmp_path / "ens_test.json"
+    prng.reset()
+    rc = Main([MNIST, "--ensemble-test", str(ens_file),
+               "--result-file", str(test_file),
+               "-v", "warning"]).run()
+    assert rc == 0
+    report = json.loads(test_file.read_text())
+    assert report["mode"] == "ensemble-test"
+    assert report["size"] == 3
+    # Joint probability-averaged prediction over the validation set.
+    assert "ensemble_validation_err" in report
+    errs = [inst["validation_err"] for inst in report["instances"]]
+    assert report["ensemble_validation_err"] <= max(errs) + 1e-9
+    assert report["ensemble_validation_err"] < 0.12
+
+
+def test_train_ratio_shrinks_train_set():
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+    from veles_tpu.loader.base import TRAIN
+
+    prng.reset()
+    prng.get(0).seed(1)
+    root.common.loader.train_ratio = 0.5
+    try:
+        launcher = Launcher()
+        wf = MnistWorkflow(launcher, max_epochs=1, learning_rate=0.1)
+        launcher.initialize()
+    finally:
+        root.common.loader.train_ratio = 1.0
+    prng.reset()
+    prng.get(0).seed(1)
+    launcher2 = Launcher()
+    wf2 = MnistWorkflow(launcher2, max_epochs=1, learning_rate=0.1)
+    launcher2.initialize()
+    full = wf2.loader.class_lengths[TRAIN]
+    half = wf.loader.class_lengths[TRAIN]
+    assert half <= full * 0.5 + 1

@@ -500,6 +500,9 @@ class AcceleratedWorkflow(Workflow):
         # >1 enables block mode: lax.scan over this many minibatches
         # per dispatch (latency-robust; one XLA computation per block).
         self.ticks_per_dispatch = kwargs.get("ticks_per_dispatch", 1)
+        # Test mode: weights frozen — every tick runs the infer step
+        # (ensemble testing / REST serving on a restored snapshot).
+        self.frozen = kwargs.get("frozen", False)
         self.step_metrics = {}
 
     def init_unpickled(self):
@@ -528,7 +531,10 @@ class AcceleratedWorkflow(Workflow):
     @property
     def training(self):
         """Whether the current tick is a training minibatch; loaders
-        override the source of truth via link."""
+        override the source of truth via link.  ``frozen`` (test mode)
+        forces inference regardless of minibatch class."""
+        if getattr(self, "frozen", False):
+            return False
         for u in self.units:
             is_train = getattr(u, "minibatch_is_training", None)
             if is_train is not None:

@@ -1,0 +1,11 @@
+"""Genetic hyperparameter optimization (reference: veles/genetics/).
+
+Config leaves wrapped in :class:`veles_tpu.config.Tune` become genes;
+an outer optimization loop evaluates model runs and evolves the
+population.  See :mod:`veles_tpu.genetics.core` for the GA engine and
+:mod:`veles_tpu.genetics.optimizer` for the run modes (standalone /
+coordinator / worker over the existing Server/Client job protocol).
+"""
+
+from .core import Chromosome, Population, collect_tunes  # noqa: F401
+from .optimizer import GeneticsOptimizer, OptimizationWorkflow  # noqa: F401

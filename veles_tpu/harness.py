@@ -1,0 +1,54 @@
+"""Shared in-process run harness for meta-workflows.
+
+Genetics and ensembles both need to run a workflow module's
+``run(load, main)`` hooks to completion inside the current process
+(reference ran a subprocess per evaluation, optimization_workflow.py:260;
+in-process is the TPU-era default — the fused-step compiler caches
+across runs).  One implementation here so launcher-driving stays in
+sync with ``Main.main``.
+"""
+
+import zlib
+
+from . import prng
+from .launcher import Launcher
+
+#: Metric key meta-workflows optimize on (provided by Decision units).
+FITNESS_KEY = "EvaluationFitness"
+
+
+def seed_to_int(spec, default=1234):
+    """``--random-seed`` values must also serve as integer seed BASES
+    for meta-workflows (instance i = base + i·prime).  Accepts an int
+    string or the documented ``file:count:dtype`` form (hashed
+    deterministically)."""
+    if spec is None or spec == "":
+        return default
+    try:
+        return int(spec)
+    except (TypeError, ValueError):
+        return zlib.crc32(str(spec).encode("utf-8")) & 0x7FFFFFFF
+
+
+def run_workflow_module(module, seed=None, **main_kwargs):
+    """Runs ``module.run(load, main)`` to completion; returns the
+    finished workflow.  ``seed`` (int) reseeds generator 0 first so
+    every evaluation starts from identical randomness."""
+    if seed is not None:
+        prng.reset()
+        prng.get(0).seed(seed)
+    state = {}
+
+    def load(WorkflowClass, **kwargs):
+        launcher = Launcher()
+        wf = WorkflowClass(launcher, **kwargs)
+        state["launcher"], state["wf"] = launcher, wf
+        return wf, False
+
+    def main(**kwargs):
+        kwargs.update(main_kwargs)
+        state["launcher"].initialize(**kwargs)
+        state["launcher"].run()
+
+    module.run(load, main)
+    return state["wf"]

@@ -71,7 +71,12 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self.epoch_number = 0
         self.prng_key = kwargs.get("prng_key", 0)
         self.shuffle_limit = kwargs.get("shuffle_limit", numpy.inf)
-        self.train_ratio = kwargs.get("train_ratio", 1.0)
+        # Per-run config default lets the ensemble trainer vary the
+        # train subset without touching workflow constructors
+        # (reference: --train-ratio flag, loader/base.py).
+        from ..config import root as _root
+        self.train_ratio = kwargs.get(
+            "train_ratio", _root.common.loader.get("train_ratio", 1.0))
         super(Loader, self).__init__(workflow, **kwargs)
         self.view_group = "LOADER"
         # Per-tick outputs (host scalars + device vectors).
@@ -125,17 +130,30 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self.load_data()
         if self.total_samples == 0:
             raise BadFormatError("loader has no samples after load_data")
+        train_subset = None
         if self.class_lengths[TRAIN] > 0 and self.train_ratio < 1.0:
-            self.class_lengths[TRAIN] = max(
-                1, int(self.class_lengths[TRAIN] * self.train_ratio))
+            # A RANDOM subset per run (ensemble bagging diversity) —
+            # not the leading slice, which would give every instance
+            # the identical samples and discard the tail entirely.
+            full_train = self.class_lengths[TRAIN]
+            keep = max(1, int(full_train * self.train_ratio))
+            train_start = self.class_lengths[0] + self.class_lengths[1]
+            train_subset = train_start + numpy.sort(
+                prng.get(self.prng_key).choice(
+                    full_train, size=keep, replace=False)
+                .astype(numpy.int32))
+            self.class_lengths[TRAIN] = keep
         resumed = bool(self.shuffled_indices) and \
             self.shuffled_indices.size == self.total_samples
         if not resumed:
             # Fresh run; a snapshot resume keeps the pickled index
             # order + global_offset so the epoch continues mid-walk
             # (reference: loader state rides the workflow pickle).
-            self.shuffled_indices.mem = numpy.arange(
-                self.total_samples, dtype=numpy.int32)
+            base = numpy.arange(self.total_samples, dtype=numpy.int32)
+            if train_subset is not None:
+                base[self.class_lengths[0] + self.class_lengths[1]:] \
+                    = train_subset
+            self.shuffled_indices.mem = base
         self.minibatch_indices.mem = numpy.zeros(
             self.max_minibatch_size, dtype=numpy.int32)
         self.minibatch_mask.mem = numpy.zeros(

@@ -277,6 +277,12 @@ class Server(Logger):
             if self._finished_locked():
                 return None
             data = self.workflow.generate_data_for_slave(desc.id)
+            if data is None:
+                # Workflow has nothing to hand out right now (e.g. a
+                # GA generation fully in flight elsewhere) — the
+                # caller sends no_job; counting it as outstanding
+                # would block _maybe_finished forever.
+                return None
             self._outstanding[desc.id] = \
                 self._outstanding.get(desc.id, 0) + 1
             return data

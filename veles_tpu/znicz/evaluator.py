@@ -77,7 +77,39 @@ class EvaluatorSoftmax(EvaluatorBase):
     def __init__(self, workflow, **kwargs):
         super(EvaluatorSoftmax, self).__init__(workflow, **kwargs)
         self.labels = None
+        # Per-sample probability capture (ensemble testing / serving):
+        # a (total_samples + 1, n_classes) on-device buffer scattered
+        # at minibatch indices inside the step — the +1 row absorbs
+        # padded lanes (their index pads with 0, which may collide
+        # with a real sample).
+        self.capture_outputs = False
+        self.sample_indices = None
+        self.capture = Vector()
         self.demand("labels", "mask", "minibatch_class_vec")
+
+    def enable_capture(self, loader):
+        """Arms probability capture; call after initialize (the
+        output width comes from the allocated logits Vector).  The
+        compiler picks the new state tensor up on its next
+        fingerprint check."""
+        self.capture_outputs = True
+        self.sample_indices = loader.minibatch_indices
+        width = int(self.input.shape[-1])
+        self.capture.mem = numpy.zeros(
+            (loader.total_samples + 1, width), dtype=numpy.float32)
+
+    def read_capture(self):
+        """Host copy of the captured per-sample probabilities
+        (trash row stripped)."""
+        self.capture.map_read()
+        return numpy.array(self.capture.mem[:-1])
+
+    @property
+    def tstate(self):
+        state = dict(super(EvaluatorSoftmax, self).tstate)
+        if self.capture_outputs and self.capture:
+            state["capture"] = self.capture
+        return state
 
     def tforward(self, read, write, params, ctx, state=None):
         import jax
@@ -95,7 +127,16 @@ class EvaluatorSoftmax(EvaluatorBase):
         ctx.set_loss(loss)
         ctx.add_metric("n_err", n_err)
         ctx.add_metric("n_valid", mask.sum())
-        return self._accumulate(read, state, n_err, mask.sum(), loss)
+        updates = self._accumulate(read, state, n_err, mask.sum(),
+                                   loss)
+        if state is not None and "capture" in state:
+            idx = read(self.sample_indices).astype(jnp.int32)
+            trash = state["capture"].shape[0] - 1
+            safe = jnp.where(mask > 0, idx, trash)
+            updates = dict(updates or {})
+            updates["capture"] = state["capture"].at[safe].set(
+                jnp.exp(logp) * mask[:, None])
+        return updates
 
 
 class EvaluatorMSE(EvaluatorBase):
