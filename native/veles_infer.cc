@@ -1,0 +1,567 @@
+/* veles_tpu native inference runtime.
+ *
+ * Role parity with libVeles (reference: libVeles/src/
+ * workflow_loader.cc:46-131 — archive extract → unit table → chain;
+ * unit.cc / unit_factory.cc — per-type Execute implementations).
+ * Parses the model.bin layout written by veles_tpu/export.py
+ * (_pack_binary) and executes the forward chain in plain C++ —
+ * NHWC activations, HWIO conv weights, semantics mirrored from
+ * ExportedModel.forward_numpy (the Python reference used by the
+ * parity tests).
+ *
+ * Build: `make -C native` → libveles_infer.so + veles_infer CLI.
+ * Only system zlib is linked (no vendored deps — the reference
+ * vendored libarchive/zlib/eina; standard libs suffice today).
+ */
+#include "veles_infer.h"
+
+#include <zlib.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error(const std::string &msg) { g_error = msg; }
+
+/* ---- model.bin parsing ---------------------------------------------- */
+
+struct Cursor {
+  const uint8_t *p, *end;
+  bool ok = true;
+  template <typename T> T read() {
+    T v{};
+    if (p + sizeof(T) > end) { ok = false; return v; }
+    std::memcpy(&v, p, sizeof(T));
+    p += sizeof(T);
+    return v;
+  }
+  std::string read_str() {
+    uint16_t n = read<uint16_t>();
+    if (!ok || p + n > end) { ok = false; return ""; }
+    std::string s(reinterpret_cast<const char *>(p), n);
+    p += n;
+    return s;
+  }
+};
+
+struct Param {
+  std::vector<uint32_t> dims;
+  std::vector<float> data;
+};
+
+struct UnitDesc {
+  std::string type, name;
+  std::map<std::string, double> cfg;
+  std::map<std::string, Param> params;
+  double cfgv(const std::string &key, double dflt = 0.0) const {
+    auto it = cfg.find(key);
+    return it == cfg.end() ? dflt : it->second;
+  }
+};
+
+struct Shape {           /* activation shape per sample */
+  int h = 1, w = 1, c = 1;
+  bool spatial = false;  /* false → flat vector of size c */
+  int size() const { return h * w * c; }
+};
+
+}  // namespace
+
+struct VtModel {
+  std::vector<UnitDesc> units;
+  std::vector<Shape> shapes;  /* shapes[i] = input of unit i;
+                                 back() = final output */
+  int in_size = 0, out_size = 0;
+};
+
+namespace {
+
+/* ---- activations (mirror of export.py _ACTS) ------------------------ */
+
+constexpr float kTanhA = 1.7159f, kTanhB = 0.6666f;
+
+inline float act_tanh(float v) { return kTanhA * std::tanh(kTanhB * v); }
+inline float act_softplus(float v) {
+  return std::log1p(std::exp(-std::fabs(v))) + std::max(v, 0.0f);
+}
+inline float act_str(float v) { return std::max(v, 0.0f); }
+inline float act_sigmoid(float v) { return 1.0f / (1.0f + std::exp(-v)); }
+
+enum class Act { kLinear, kTanh, kSoftplus, kStr, kSigmoid, kSoftmax };
+
+Act act_of(const std::string &type) {
+  if (type == "all2all_tanh" || type == "conv_tanh" ||
+      type == "activation_tanh")
+    return Act::kTanh;
+  if (type == "all2all_relu" || type == "conv_relu" ||
+      type == "activation_relu")
+    return Act::kSoftplus;
+  if (type == "all2all_str" || type == "conv_str" ||
+      type == "activation_str")
+    return Act::kStr;
+  if (type == "all2all_sigmoid" || type == "conv_sigmoid" ||
+      type == "activation_sigmoid")
+    return Act::kSigmoid;
+  if (type == "softmax") return Act::kSoftmax;
+  return Act::kLinear;
+}
+
+void apply_act(Act act, float *v, int n, int row_len) {
+  switch (act) {
+    case Act::kLinear: return;
+    case Act::kTanh:
+      for (int i = 0; i < n; ++i) v[i] = act_tanh(v[i]);
+      return;
+    case Act::kSoftplus:
+      for (int i = 0; i < n; ++i) v[i] = act_softplus(v[i]);
+      return;
+    case Act::kStr:
+      for (int i = 0; i < n; ++i) v[i] = act_str(v[i]);
+      return;
+    case Act::kSigmoid:
+      for (int i = 0; i < n; ++i) v[i] = act_sigmoid(v[i]);
+      return;
+    case Act::kSoftmax:
+      for (int r = 0; r < n / row_len; ++r) {
+        float *row = v + r * row_len;
+        float mx = row[0];
+        for (int j = 1; j < row_len; ++j) mx = std::max(mx, row[j]);
+        float sum = 0.0f;
+        for (int j = 0; j < row_len; ++j) {
+          row[j] = std::exp(row[j] - mx);
+          sum += row[j];
+        }
+        for (int j = 0; j < row_len; ++j) row[j] /= sum;
+      }
+      return;
+  }
+}
+
+/* ---- per-unit Execute (reference: unit.h:41) ------------------------ */
+
+void run_dense(const UnitDesc &u, const float *in, float *out,
+               int batch, int fan_in, int n_out) {
+  const Param &w = u.params.at("weights");
+  const float *b = nullptr;
+  auto bit = u.params.find("bias");
+  if (bit != u.params.end()) b = bit->second.data.data();
+  for (int s = 0; s < batch; ++s) {
+    const float *x = in + s * fan_in;
+    float *y = out + s * n_out;
+    for (int j = 0; j < n_out; ++j) y[j] = b ? b[j] : 0.0f;
+    for (int i = 0; i < fan_in; ++i) {
+      const float xi = x[i];
+      if (xi == 0.0f) continue;
+      const float *wr = w.data.data() + i * n_out;
+      for (int j = 0; j < n_out; ++j) y[j] += xi * wr[j];
+    }
+  }
+  apply_act(act_of(u.type), out, batch * n_out, n_out);
+}
+
+void run_conv(const UnitDesc &u, const float *in, float *out,
+              int batch, const Shape &si, const Shape &so) {
+  const Param &w = u.params.at("weights"); /* HWIO */
+  const int ky = w.dims[0], kx = w.dims[1], ci = w.dims[2],
+            co = w.dims[3];
+  const float *b = nullptr;
+  auto bit = u.params.find("bias");
+  if (bit != u.params.end()) b = bit->second.data.data();
+  const int pt = (int)u.cfgv("pad_top"), pl = (int)u.cfgv("pad_left");
+  const int sh = (int)u.cfgv("stride_h", 1),
+            sw = (int)u.cfgv("stride_w", 1);
+  for (int s = 0; s < batch; ++s) {
+    const float *x = in + s * si.size();
+    float *y = out + s * so.size();
+    for (int oy = 0; oy < so.h; ++oy)
+      for (int ox = 0; ox < so.w; ++ox) {
+        float *yp = y + (oy * so.w + ox) * co;
+        for (int j = 0; j < co; ++j) yp[j] = b ? b[j] : 0.0f;
+        const int iy0 = oy * sh - pt, ix0 = ox * sw - pl;
+        for (int dy = 0; dy < ky; ++dy) {
+          const int iy = iy0 + dy;
+          if (iy < 0 || iy >= si.h) continue; /* zero padding */
+          for (int dx = 0; dx < kx; ++dx) {
+            const int ix = ix0 + dx;
+            if (ix < 0 || ix >= si.w) continue;
+            const float *xp = x + (iy * si.w + ix) * ci;
+            const float *wp =
+                w.data.data() + ((dy * kx + dx) * ci) * co;
+            for (int i = 0; i < ci; ++i) {
+              const float xi = xp[i];
+              const float *wr = wp + i * co;
+              for (int j = 0; j < co; ++j) yp[j] += xi * wr[j];
+            }
+          }
+        }
+      }
+  }
+  apply_act(act_of(u.type), out, batch * so.size(), co);
+}
+
+void run_pool(const UnitDesc &u, const float *in, float *out,
+              int batch, const Shape &si, const Shape &so) {
+  const int ky = (int)u.cfgv("ky"), kx = (int)u.cfgv("kx");
+  const int pt = (int)u.cfgv("pad_top"), pl = (int)u.cfgv("pad_left");
+  const int sh = (int)u.cfgv("stride_h", 1),
+            sw = (int)u.cfgv("stride_w", 1);
+  const bool is_avg = u.type == "avg_pooling";
+  const bool is_abs = u.type == "maxabs_pooling";
+  const int c = si.c;
+  for (int s = 0; s < batch; ++s) {
+    const float *x = in + s * si.size();
+    float *y = out + s * so.size();
+    for (int oy = 0; oy < so.h; ++oy)
+      for (int ox = 0; ox < so.w; ++ox) {
+        float *yp = y + (oy * so.w + ox) * c;
+        const int iy0 = oy * sh - pt, ix0 = ox * sw - pl;
+        for (int j = 0; j < c; ++j) {
+          float best = 0.0f, sum = 0.0f;
+          int count = 0;
+          bool first = true;
+          for (int dy = 0; dy < ky; ++dy) {
+            const int iy = iy0 + dy;
+            if (iy < 0 || iy >= si.h) continue;
+            for (int dx = 0; dx < kx; ++dx) {
+              const int ix = ix0 + dx;
+              if (ix < 0 || ix >= si.w) continue;
+              const float v = x[(iy * si.w + ix) * c + j];
+              if (is_avg) {
+                sum += v;
+                ++count;
+              } else if (first ||
+                         (is_abs ? std::fabs(v) > std::fabs(best)
+                                 : v > best)) {
+                best = v;
+                first = false;
+              }
+            }
+          }
+          yp[j] = is_avg ? (count ? sum / count : 0.0f) : best;
+        }
+      }
+  }
+}
+
+void run_lrn(const UnitDesc &u, const float *in, float *out,
+             int batch, const Shape &si) {
+  const double alpha = u.cfgv("alpha"), beta = u.cfgv("beta"),
+               k = u.cfgv("k");
+  const int n = (int)u.cfgv("n"), c = si.c, half = n / 2;
+  const int pixels = batch * si.h * si.w;
+  for (int px = 0; px < pixels; ++px) {
+    const float *x = in + px * c;
+    float *y = out + px * c;
+    for (int j = 0; j < c; ++j) {
+      const int lo = std::max(0, j - half);
+      const int hi = std::min(c, j + (n - 1 - half) + 1);
+      double ssum = 0.0;
+      for (int i = lo; i < hi; ++i) ssum += (double)x[i] * x[i];
+      y[j] = (float)(x[j] /
+                     std::pow(k + (alpha / n) * ssum, beta));
+    }
+  }
+}
+
+void run_mean_disp(const UnitDesc &u, const float *in, float *out,
+                   int batch, int sample) {
+  const float *mean = u.params.at("mean").data.data();
+  const float *rdisp = u.params.at("rdisp").data.data();
+  for (int s = 0; s < batch; ++s)
+    for (int i = 0; i < sample; ++i)
+      out[s * sample + i] = (in[s * sample + i] - mean[i]) * rdisp[i];
+}
+
+/* ---- shape propagation (mirror of export geometry) ------------------ */
+
+bool infer_shapes(VtModel *m) {
+  for (size_t i = 0; i < m->units.size(); ++i) {
+    const UnitDesc &u = m->units[i];
+    const Shape &si = m->shapes[i];
+    Shape so = si;
+    const std::string &t = u.type;
+    if (t.rfind("all2all", 0) == 0 || t == "softmax") {
+      so = Shape{1, 1, (int)u.cfgv("n_out"), false};
+    } else if (t.rfind("conv", 0) == 0) {
+      const Param &w = u.params.at("weights");
+      const int ky = w.dims[0], kx = w.dims[1];
+      const int sh = (int)u.cfgv("stride_h", 1),
+                sw = (int)u.cfgv("stride_w", 1);
+      const int ph = (int)(u.cfgv("pad_top") + u.cfgv("pad_bottom"));
+      const int pw = (int)(u.cfgv("pad_left") + u.cfgv("pad_right"));
+      so.h = (si.h + ph - ky) / sh + 1;
+      so.w = (si.w + pw - kx) / sw + 1;
+      so.c = (int)w.dims[3];
+      so.spatial = true;
+    } else if (t.find("pooling") != std::string::npos) {
+      const int ky = (int)u.cfgv("ky"), kx = (int)u.cfgv("kx");
+      const int sh = (int)u.cfgv("stride_h", 1),
+                sw = (int)u.cfgv("stride_w", 1);
+      const int ph = (int)(u.cfgv("pad_top") + u.cfgv("pad_bottom"));
+      const int pw = (int)(u.cfgv("pad_left") + u.cfgv("pad_right"));
+      /* ceil mode (znicz pools the ragged tail) */
+      so.h = (si.h + ph - ky + sh - 1) / sh + 1;
+      so.w = (si.w + pw - kx + sw - 1) / sw + 1;
+    } else if (t == "norm" || t == "dropout" ||
+               t.rfind("activation_", 0) == 0 || t == "mean_disp") {
+      /* shape-preserving */
+    } else {
+      set_error("unknown unit type: " + t);
+      return false;
+    }
+    m->shapes.push_back(so);
+  }
+  m->in_size = m->shapes.front().size();
+  m->out_size = m->shapes.back().size();
+  return true;
+}
+
+bool parse_model(const uint8_t *data, size_t size, VtModel *m) {
+  Cursor c{data, data + size};
+  char magic[4];
+  for (char &ch : magic) ch = (char)c.read<uint8_t>();
+  if (!c.ok || std::memcmp(magic, "VTPM", 4) != 0) {
+    set_error("bad magic (not a veles-tpu model.bin)");
+    return false;
+  }
+  const uint32_t version = c.read<uint32_t>();
+  if (version > 1) {
+    set_error("model.bin version too new: " + std::to_string(version));
+    return false;
+  }
+  const uint32_t n_units = c.read<uint32_t>();
+  const uint32_t in_ndim = c.read<uint32_t>();
+  std::vector<uint32_t> in_shape(in_ndim);
+  for (auto &d : in_shape) d = c.read<uint32_t>();
+  Shape s0;
+  if (in_ndim == 3) {
+    s0 = Shape{(int)in_shape[0], (int)in_shape[1], (int)in_shape[2],
+               true};
+  } else {
+    int flat = 1;
+    for (auto d : in_shape) flat *= (int)d;
+    s0 = Shape{1, 1, flat, false};
+  }
+  m->shapes.push_back(s0);
+  for (uint32_t i = 0; i < n_units && c.ok; ++i) {
+    UnitDesc u;
+    u.type = c.read_str();
+    u.name = c.read_str();
+    const uint32_t n_cfg = c.read<uint32_t>();
+    for (uint32_t j = 0; j < n_cfg && c.ok; ++j) {
+      std::string key = c.read_str();
+      u.cfg[key] = c.read<double>();
+    }
+    const uint32_t n_par = c.read<uint32_t>();
+    for (uint32_t j = 0; j < n_par && c.ok; ++j) {
+      std::string pname = c.read_str();
+      Param p;
+      const uint32_t ndim = c.read<uint32_t>();
+      uint64_t count = 1;
+      for (uint32_t d = 0; d < ndim && c.ok; ++d) {
+        p.dims.push_back(c.read<uint32_t>());
+        count *= p.dims.back();
+      }
+      if (!c.ok || c.p + count * 4 > c.end) {
+        set_error("truncated param data");
+        return false;
+      }
+      p.data.resize(count);
+      std::memcpy(p.data.data(), c.p, count * 4);
+      c.p += count * 4;
+      u.params.emplace(std::move(pname), std::move(p));
+    }
+    m->units.push_back(std::move(u));
+  }
+  if (!c.ok) {
+    set_error("truncated model.bin");
+    return false;
+  }
+  return infer_shapes(m);
+}
+
+/* ---- container handling: raw model.bin OR .tgz ---------------------- */
+
+bool read_file_inflated(const char *path, std::vector<uint8_t> *out) {
+  /* gzread passes plain files through untouched, so one code path
+   * serves both model.bin and model.veles.tgz. */
+  gzFile f = gzopen(path, "rb");
+  if (!f) {
+    set_error(std::string("cannot open ") + path);
+    return false;
+  }
+  uint8_t buf[1 << 16];
+  int n;
+  while ((n = gzread(f, buf, sizeof(buf))) > 0)
+    out->insert(out->end(), buf, buf + n);
+  gzclose(f);
+  if (n < 0) {
+    set_error("decompression failed");
+    return false;
+  }
+  return true;
+}
+
+/* Minimal ustar walk: 512-byte headers, name at 0, octal size at
+ * 124. */
+bool find_in_tar(const std::vector<uint8_t> &tar,
+                 const std::string &want, const uint8_t **blob,
+                 size_t *blob_size) {
+  size_t off = 0;
+  while (off + 512 <= tar.size()) {
+    const char *hdr = reinterpret_cast<const char *>(&tar[off]);
+    if (hdr[0] == '\0') break; /* end blocks */
+    std::string name(hdr, strnlen(hdr, 100));
+    char size_field[13] = {0};
+    std::memcpy(size_field, hdr + 124, 12);
+    const size_t fsize = std::strtoul(size_field, nullptr, 8);
+    if (name == want) {
+      if (off + 512 + fsize > tar.size()) {
+        set_error("truncated tar entry");
+        return false;
+      }
+      *blob = &tar[off + 512];
+      *blob_size = fsize;
+      return true;
+    }
+    off += 512 + ((fsize + 511) / 512) * 512;
+  }
+  set_error("model.bin not found in archive");
+  return false;
+}
+
+}  // namespace
+
+/* ---- C API ----------------------------------------------------------- */
+
+extern "C" {
+
+VtModel *vt_load(const char *path) {
+  std::vector<uint8_t> raw;
+  if (!read_file_inflated(path, &raw)) return nullptr;
+  const uint8_t *blob = raw.data();
+  size_t blob_size = raw.size();
+  if (raw.size() < 4 || std::memcmp(raw.data(), "VTPM", 4) != 0) {
+    if (!find_in_tar(raw, "model.bin", &blob, &blob_size))
+      return nullptr;
+  }
+  auto model = std::make_unique<VtModel>();
+  if (!parse_model(blob, blob_size, model.get())) return nullptr;
+  return model.release();
+}
+
+int vt_input_size(const VtModel *m) { return m ? m->in_size : -1; }
+int vt_output_size(const VtModel *m) { return m ? m->out_size : -1; }
+int vt_unit_count(const VtModel *m) {
+  return m ? (int)m->units.size() : -1;
+}
+const char *vt_unit_type(const VtModel *m, int index) {
+  if (!m || index < 0 || index >= (int)m->units.size()) return nullptr;
+  return m->units[index].type.c_str();
+}
+
+int vt_forward(const VtModel *m, const float *input, int batch,
+               float *output) {
+  if (!m || !input || !output || batch <= 0) {
+    set_error("bad arguments");
+    return 1;
+  }
+  std::vector<float> a(input, input + (size_t)batch * m->in_size);
+  std::vector<float> b;
+  for (size_t i = 0; i < m->units.size(); ++i) {
+    const UnitDesc &u = m->units[i];
+    const Shape &si = m->shapes[i];
+    const Shape &so = m->shapes[i + 1];
+    b.assign((size_t)batch * so.size(), 0.0f);
+    const std::string &t = u.type;
+    if (t.rfind("all2all", 0) == 0 || t == "softmax") {
+      run_dense(u, a.data(), b.data(), batch, si.size(), so.size());
+    } else if (t.rfind("conv", 0) == 0) {
+      run_conv(u, a.data(), b.data(), batch, si, so);
+    } else if (t.find("pooling") != std::string::npos) {
+      run_pool(u, a.data(), b.data(), batch, si, so);
+    } else if (t == "norm") {
+      run_lrn(u, a.data(), b.data(), batch, si);
+    } else if (t == "mean_disp") {
+      run_mean_disp(u, a.data(), b.data(), batch, si.size());
+    } else if (t == "dropout") {
+      b = a;
+    } else if (t.rfind("activation_", 0) == 0) {
+      b = a;
+      Act act = act_of(t);
+      apply_act(act, b.data(), batch * so.size(), so.c);
+    } else {
+      set_error("unknown unit type at run time: " + t);
+      return 1;
+    }
+    a.swap(b);
+  }
+  std::memcpy(output, a.data(),
+              (size_t)batch * m->out_size * sizeof(float));
+  return 0;
+}
+
+void vt_free(VtModel *m) { delete m; }
+
+const char *vt_error(void) { return g_error.c_str(); }
+
+}  /* extern "C" */
+
+/* ---- CLI (role of the libVeles sample runner) ------------------------ */
+#ifdef VELES_INFER_MAIN
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    std::fprintf(stderr,
+                 "usage: %s <model.veles.tgz|model.bin> "
+                 "[input.f32 [batch]]\n"
+                 "Reads float32 samples from input.f32 (or zeros), "
+                 "writes outputs as text to stdout.\n",
+                 argv[0]);
+    return 2;
+  }
+  VtModel *m = vt_load(argv[1]);
+  if (!m) {
+    std::fprintf(stderr, "load failed: %s\n", vt_error());
+    return 1;
+  }
+  std::fprintf(stderr, "loaded: %d units, input %d, output %d\n",
+               vt_unit_count(m), vt_input_size(m), vt_output_size(m));
+  int batch = argc > 3 ? std::atoi(argv[3]) : 1;
+  std::vector<float> in((size_t)batch * vt_input_size(m), 0.0f);
+  if (argc > 2) {
+    std::FILE *f = std::fopen(argv[2], "rb");
+    if (!f) {
+      std::fprintf(stderr, "cannot open %s\n", argv[2]);
+      return 1;
+    }
+    size_t got = std::fread(in.data(), sizeof(float), in.size(), f);
+    std::fclose(f);
+    if (got != in.size()) {
+      std::fprintf(stderr, "short read: %zu/%zu floats\n", got,
+                   in.size());
+      return 1;
+    }
+  }
+  std::vector<float> out((size_t)batch * vt_output_size(m));
+  if (vt_forward(m, in.data(), batch, out.data()) != 0) {
+    std::fprintf(stderr, "forward failed: %s\n", vt_error());
+    return 1;
+  }
+  for (int s = 0; s < batch; ++s) {
+    for (int j = 0; j < vt_output_size(m); ++j)
+      std::printf("%s%g", j ? " " : "", out[s * vt_output_size(m) + j]);
+    std::printf("\n");
+  }
+  vt_free(m);
+  return 0;
+}
+#endif

@@ -1,0 +1,46 @@
+/* veles_tpu native inference runtime — C API.
+ *
+ * Role parity with libVeles (reference: libVeles/inc/veles/
+ * workflow_loader.h:43-80, unit.h:26-49): load an exported workflow
+ * artifact and run forward passes over float buffers with no Python,
+ * JAX, or framework dependency.  The artifact is the tar.gz written
+ * by veles_tpu.export.export_workflow; vt_load accepts either the
+ * .tgz itself (zlib inflates it, the embedded tar is walked for
+ * model.bin) or a bare model.bin.
+ */
+#ifndef VELES_INFER_H_
+#define VELES_INFER_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct VtModel VtModel;
+
+/* Returns NULL on failure; see vt_error(). */
+VtModel *vt_load(const char *path);
+
+/* Flattened per-sample element counts. */
+int vt_input_size(const VtModel *model);
+int vt_output_size(const VtModel *model);
+
+/* Number of units in the chain (introspection). */
+int vt_unit_count(const VtModel *model);
+const char *vt_unit_type(const VtModel *model, int index);
+
+/* Runs the chain over `batch` samples; `input` holds
+ * batch*vt_input_size floats, `output` receives
+ * batch*vt_output_size floats.  Returns 0 on success. */
+int vt_forward(const VtModel *model, const float *input, int batch,
+               float *output);
+
+void vt_free(VtModel *model);
+
+/* Last error message (thread-local). */
+const char *vt_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* VELES_INFER_H_ */

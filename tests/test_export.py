@@ -1,0 +1,149 @@
+"""Export artifact tests: train → export → re-execute from the
+artifact alone, on both the jax serving path and the numpy
+native-runtime mirror (reference capability: libVeles
+workflow_loader.cc:46-131 + unit.h:41 Execute chain)."""
+
+import json
+import tarfile
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.export import ExportedModel, export_workflow
+from veles_tpu.launcher import Launcher
+
+
+@pytest.fixture(scope="module")
+def mnist_trained():
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+    prng.reset()
+    prng.get(0).seed(1234)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=3, learning_rate=0.1)
+    launcher.initialize()
+    launcher.run()
+    return wf
+
+
+@pytest.fixture(scope="module")
+def mnist_artifact(mnist_trained, tmp_path_factory):
+    path = tmp_path_factory.mktemp("export") / "mnist.veles.tgz"
+    export_workflow(mnist_trained, str(path))
+    return str(path)
+
+
+def _live_probs(wf):
+    """Ground truth: per-sample probabilities captured from the live
+    (jitted, bf16) model during a frozen epoch."""
+    decision = wf.decision
+    decision.max_epochs = decision.epoch_number + 1
+    decision.fail_iterations = float("inf")
+    decision.complete <<= False
+    wf.frozen = True
+    wf.evaluator.enable_capture(wf.loader)
+    wf._finished_.clear()
+    wf.run()
+    wf.frozen = False
+    return wf.evaluator.read_capture()
+
+
+def test_artifact_structure(mnist_artifact):
+    with tarfile.open(mnist_artifact) as tar:
+        names = set(tar.getnames())
+        assert {"manifest.json", "weights.npz",
+                "model.bin"} <= names
+        manifest = json.loads(
+            tar.extractfile("manifest.json").read())
+    assert manifest["format"] == "veles-tpu-model"
+    assert manifest["version"] == 1
+    types = [u["type"] for u in manifest["units"]]
+    assert types == ["all2all_tanh", "softmax"]
+    assert manifest["input"]["sample_shape"] == [784]
+    assert manifest["output"]["sample_shape"] == [10]
+
+
+def test_exported_matches_live(mnist_trained, mnist_artifact):
+    model = ExportedModel(mnist_artifact)
+    loader = mnist_trained.loader
+    loader.original_data.map_read()
+    x = numpy.array(loader.original_data.mem, dtype=numpy.float32)
+    live = _live_probs(mnist_trained)
+    got = model.forward(x)
+    # live runs bf16; export runs f32 — compare predictions plus a
+    # loose probability tolerance.
+    agree = numpy.mean(numpy.argmax(got, -1) == numpy.argmax(live, -1))
+    assert agree > 0.99
+    assert numpy.max(numpy.abs(got - live)) < 0.05
+
+
+def test_numpy_path_matches_jax_path(mnist_artifact, mnist_trained):
+    model = ExportedModel(mnist_artifact)
+    loader = mnist_trained.loader
+    loader.original_data.map_read()
+    x = numpy.array(loader.original_data.mem[:64],
+                    dtype=numpy.float32)
+    numpy.testing.assert_allclose(model.forward_numpy(x),
+                                  model.forward(x),
+                                  rtol=1e-4, atol=1e-5)
+
+
+def test_conv_chain_export(tmp_path):
+    """Conv/pool/FC chain round-trips through the artifact."""
+    from veles_tpu.znicz.samples.cifar import (CifarWorkflow,
+                                               cifar_layers)
+    prng.reset()
+    prng.get(0).seed(4242)
+    layers = cifar_layers(0.02, 0.9, 0.0)
+    for cfg in layers:
+        if "weights_stddev" in cfg.get("->", {}):
+            cfg["->"]["weights_stddev"] = 0.05
+    launcher = Launcher()
+    wf = CifarWorkflow(launcher, max_epochs=2, minibatch_size=100,
+                       layers=layers)
+    launcher.initialize()
+    launcher.run()
+    path = tmp_path / "cifar.veles.tgz"
+    export_workflow(wf, str(path))
+    model = ExportedModel(str(path))
+    types = [u["type"] for u in model.units]
+    assert types == ["conv_str", "max_pooling", "conv_str",
+                     "avg_pooling", "conv_str", "avg_pooling",
+                     "all2all_tanh", "softmax"]
+    loader = wf.loader
+    loader.original_data.map_read()
+    x = numpy.array(loader.original_data.mem[:32],
+                    dtype=numpy.float32)
+    live = _live_probs(wf)[:32]
+    jax_probs = model.forward(x)
+    np_probs = model.forward_numpy(x)
+    numpy.testing.assert_allclose(np_probs, jax_probs, rtol=1e-3,
+                                  atol=1e-4)
+    agree = numpy.mean(numpy.argmax(jax_probs, -1) ==
+                       numpy.argmax(live, -1))
+    assert agree > 0.95
+    assert numpy.max(numpy.abs(jax_probs - live)) < 0.08
+
+
+def test_version_gate(tmp_path, mnist_artifact):
+    import io
+    import shutil
+    bad = tmp_path / "bad.veles.tgz"
+    shutil.copy(mnist_artifact, bad)
+    # Bump the version beyond what this runtime understands.
+    with tarfile.open(bad) as tar:
+        manifest = json.loads(tar.extractfile("manifest.json").read())
+        weights = tar.extractfile("weights.npz").read()
+        modelbin = tar.extractfile("model.bin").read()
+    manifest["version"] = 999
+    with tarfile.open(bad, "w:gz") as tar:
+        for name, blob in (("manifest.json",
+                            json.dumps(manifest).encode()),
+                           ("weights.npz", weights),
+                           ("model.bin", modelbin)):
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    from veles_tpu.error import Bug
+    with pytest.raises(Bug):
+        ExportedModel(str(bad))

@@ -1,0 +1,106 @@
+"""Native C++ runtime parity tests (reference capability: libVeles
+standalone inference, workflow_loader.cc:46-131 + unit.h:41 —
+deploy a trained model with no Python/framework dependency)."""
+
+import os
+import subprocess
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.export import ExportedModel, export_workflow
+from veles_tpu.launcher import Launcher
+from veles_tpu.native import NativeModel, build_native
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """One FC artifact (MNIST) + one conv artifact (CIFAR)."""
+    out = {}
+    tmp = tmp_path_factory.mktemp("native")
+
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+    prng.reset()
+    prng.get(0).seed(1234)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=2, learning_rate=0.1)
+    launcher.initialize()
+    launcher.run()
+    out["mnist"] = str(tmp / "mnist.veles.tgz")
+    export_workflow(wf, out["mnist"])
+
+    from veles_tpu.znicz.samples.cifar import (CifarWorkflow,
+                                               cifar_layers)
+    prng.reset()
+    prng.get(0).seed(4242)
+    layers = cifar_layers(0.02, 0.9, 0.0)
+    for cfg in layers:
+        if "weights_stddev" in cfg.get("->", {}):
+            cfg["->"]["weights_stddev"] = 0.05
+    launcher = Launcher()
+    wf = CifarWorkflow(launcher, max_epochs=1, minibatch_size=100,
+                       layers=layers)
+    launcher.initialize()
+    launcher.run()
+    out["cifar"] = str(tmp / "cifar.veles.tgz")
+    export_workflow(wf, out["cifar"])
+    return out
+
+
+def test_native_builds():
+    path = build_native()
+    assert os.path.isfile(path)
+
+
+def test_native_matches_python_fc(artifacts):
+    py = ExportedModel(artifacts["mnist"])
+    nat = NativeModel(artifacts["mnist"])
+    assert nat.unit_types == [u["type"] for u in py.units]
+    assert nat.input_size == 784
+    assert nat.output_size == 10
+    rng = numpy.random.RandomState(0)
+    x = rng.rand(16, 784).astype(numpy.float32)
+    numpy.testing.assert_allclose(
+        nat.forward(x), py.forward_numpy(x), rtol=1e-4, atol=1e-5)
+
+
+def test_native_matches_python_conv(artifacts):
+    py = ExportedModel(artifacts["cifar"])
+    nat = NativeModel(artifacts["cifar"])
+    rng = numpy.random.RandomState(1)
+    x = rng.rand(4, 32, 32, 3).astype(numpy.float32) * 2 - 1
+    got = nat.forward(x)
+    want = py.forward_numpy(x).reshape(4, -1)
+    numpy.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+
+
+def test_native_cli_runs(artifacts, tmp_path):
+    """The standalone binary loads the .tgz directly and predicts."""
+    build_native()
+    binary = os.path.join(REPO, "native", "veles_infer")
+    assert os.path.isfile(binary)
+    x = numpy.random.RandomState(2).rand(2, 784).astype(numpy.float32)
+    raw = tmp_path / "in.f32"
+    raw.write_bytes(x.tobytes())
+    proc = subprocess.run(
+        [binary, artifacts["mnist"], str(raw), "2"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    rows = [numpy.array([float(v) for v in line.split()])
+            for line in proc.stdout.strip().splitlines()]
+    assert len(rows) == 2
+    py = ExportedModel(artifacts["mnist"])
+    want = py.forward_numpy(x)
+    numpy.testing.assert_allclose(numpy.stack(rows), want, rtol=1e-3,
+                                  atol=1e-5)
+
+
+def test_native_rejects_garbage(tmp_path):
+    bad = tmp_path / "junk.bin"
+    bad.write_bytes(b"not a model at all")
+    from veles_tpu.error import Bug
+    with pytest.raises(Bug):
+        NativeModel(str(bad))

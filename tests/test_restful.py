@@ -1,0 +1,118 @@
+"""REST serving tests (reference capability: veles/restful_api.py:78
+— trained workflow answers HTTP POST /api)."""
+
+import base64
+import json
+import urllib.request
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.export import ExportedModel, export_workflow
+from veles_tpu.launcher import Launcher
+from veles_tpu.restful import ModelServer, RESTfulAPI
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+    prng.reset()
+    prng.get(0).seed(1234)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=3, learning_rate=0.1)
+    launcher.initialize()
+    launcher.run()
+    path = str(tmp_path_factory.mktemp("serve") / "m.veles.tgz")
+    export_workflow(wf, path)
+    server = ModelServer(path, host="127.0.0.1", port=0).start()
+    yield wf, path, server
+    server.stop()
+
+
+def _post(port, payload):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/api" % port,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_health(served):
+    _, _, server = served
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d/health" % server.port,
+            timeout=30) as resp:
+        info = json.loads(resp.read())
+    assert info["status"] == "ok"
+    assert info["input"]["sample_shape"] == [784]
+
+
+def test_predicts_json_array(served):
+    wf, path, server = served
+    loader = wf.loader
+    loader.original_data.map_read()
+    loader.original_labels.map_read()
+    x = numpy.array(loader.original_data.mem[:8],
+                    dtype=numpy.float32)
+    status, reply = _post(server.port, {"input": x.tolist()})
+    assert status == 200
+    model = ExportedModel(path)
+    want = model.forward(x)
+    numpy.testing.assert_allclose(
+        numpy.array(reply["output"]), want, rtol=1e-4, atol=1e-5)
+    assert reply["labels"] == list(numpy.argmax(want, -1))
+
+
+def test_predicts_base64_single_sample(served):
+    wf, path, server = served
+    loader = wf.loader
+    loader.original_data.map_read()
+    x = numpy.array(loader.original_data.mem[3],
+                    dtype=numpy.float32)
+    status, reply = _post(server.port, {
+        "input": base64.b64encode(x.tobytes()).decode()})
+    assert status == 200
+    assert len(reply["output"]) == 1
+    model = ExportedModel(path)
+    assert reply["labels"][0] == int(
+        numpy.argmax(model.forward(x[None])))
+
+
+def test_bad_request_is_400(served):
+    _, _, server = served
+    status, reply = _post(server.port, {"input": [1.0, 2.0, 3.0]})
+    assert status == 400
+    assert "error" in reply
+    status, _ = _post(server.port, {"nonsense": True})
+    assert status == 400
+
+
+def test_restful_unit_serves_after_training(tmp_path):
+    """The in-workflow RESTfulAPI unit exports + serves when the
+    training loop completes."""
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+    prng.reset()
+    prng.get(0).seed(5)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=2, learning_rate=0.1)
+    api = RESTfulAPI(wf, port=0, artifact_path=str(
+        tmp_path / "served.veles.tgz"))
+    # Fires each tick right after the decision; gated until training
+    # completes (linking after the terminal EndPoint would be too
+    # late — the FIFO drains once the end point runs).
+    api.link_from(wf.decision)
+    api.gate_block = ~wf.decision.complete
+    launcher.initialize()
+    launcher.run()
+    try:
+        assert api.server is not None
+        status, reply = _post(api.port, {"input": [[0.0] * 784]})
+        assert status == 200
+        assert len(reply["output"][0]) == 10
+    finally:
+        api.stop()

@@ -55,12 +55,13 @@ class EnsembleTrainer(Logger):
         self.stem = stem
 
     def _train_one(self, index, seed):
+        prior = root.common.loader.get("train_ratio", 1.0)
         root.common.loader.train_ratio = self.train_ratio
         try:
             wf = run_workflow_module(self.module, seed=seed)
         finally:
             # Never leak the subset ratio into later runs.
-            root.common.loader.train_ratio = 1.0
+            root.common.loader.train_ratio = prior
         os.makedirs(self.snapshot_dir, exist_ok=True)
         snapshot = os.path.join(
             self.snapshot_dir,

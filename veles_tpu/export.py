@@ -1,0 +1,502 @@
+"""Trained-workflow export: a Python-independent inference artifact.
+
+Capability parity with the reference export + libVeles loader
+(reference: libVeles/src/workflow_loader.cc:46-131 — extract archive,
+parse unit table, build an executable chain; libVeles/inc/veles/unit.h:41
+— ``Unit::Execute`` forward over float buffers): a trained
+:class:`~veles_tpu.accelerated_units.AcceleratedWorkflow`'s forward
+chain is serialized to a versioned tar.gz holding
+
+* ``manifest.json`` — format version, unit table (type MAPPING +
+  numeric config), input/output specs, provenance;
+* ``weights.npz`` — all parameters as named float32 arrays;
+* ``model.bin`` — the same topology+weights in a flat binary layout
+  the native C++ runtime (``native/veles_infer.cc``) parses without
+  Python, JSON, or zlib.
+
+:class:`ExportedModel` re-executes the chain from the artifact alone —
+``forward()`` builds a jitted jax chain (serving path, TPU-capable),
+``forward_numpy()`` is a dependency-free reference used to validate
+the native runtime.
+"""
+
+import io
+import json
+import os
+import struct
+import tarfile
+import time
+
+import numpy
+
+from .error import Bug
+from .json_encoders import dumps_json
+
+FORMAT_NAME = "veles-tpu-model"
+FORMAT_VERSION = 1
+MAGIC = b"VTPM"
+
+#: Unit types the artifact format understands, with their exportable
+#: numeric config keys.
+EXPORTABLE = {
+    "all2all": (), "all2all_tanh": (), "all2all_relu": (),
+    "all2all_str": (), "all2all_sigmoid": (), "softmax": (),
+    "conv": ("kx", "ky", "n_kernels"),
+    "conv_tanh": ("kx", "ky", "n_kernels"),
+    "conv_relu": ("kx", "ky", "n_kernels"),
+    "conv_str": ("kx", "ky", "n_kernels"),
+    "conv_sigmoid": ("kx", "ky", "n_kernels"),
+    "max_pooling": ("kx", "ky"),
+    "maxabs_pooling": ("kx", "ky"),
+    "avg_pooling": ("kx", "ky"),
+    "norm": ("alpha", "beta", "k", "n"),
+    "dropout": (),
+    "mean_disp": (),
+    "activation_tanh": (), "activation_relu": (),
+    "activation_str": (), "activation_sigmoid": (),
+}
+
+TANH_A, TANH_B = 1.7159, 0.6666
+
+
+def _unit_entry(unit):
+    """manifest entry + {param_name: array} for one forward unit."""
+    mapping = getattr(type(unit), "MAPPING", None)
+    from .mean_disp_normalizer import MeanDispNormalizer
+    if isinstance(unit, MeanDispNormalizer):
+        mapping = "mean_disp"
+    if mapping not in EXPORTABLE:
+        raise Bug("unit %s (type %s, MAPPING %r) is not exportable" %
+                  (unit.name, type(unit).__name__, mapping))
+    config = {}
+    for key in EXPORTABLE[mapping]:
+        config[key] = getattr(unit, key)
+    # Geometry carried uniformly when present.
+    for key in ("padding", "sliding"):
+        if hasattr(unit, key):
+            config[key] = getattr(unit, key)
+    if hasattr(unit, "output_sample_shape") and \
+            unit.output_sample_shape is not None:
+        config["output_sample_shape"] = list(unit.output_sample_shape)
+    params = {}
+    if mapping == "mean_disp":
+        for pname in ("mean", "rdisp"):
+            vec = getattr(unit, pname)
+            vec.map_read()
+            params[pname] = numpy.asarray(
+                vec.mem, dtype=numpy.float32)
+    else:
+        for pname, vec in getattr(unit, "trainables", {}).items():
+            if not vec:
+                continue
+            vec.map_read()
+            params[pname] = numpy.asarray(
+                vec.mem, dtype=numpy.float32)
+    return {"name": unit.name, "type": mapping,
+            "config": config}, params
+
+
+def forward_chain(workflow):
+    """The exportable forward units, in execution order.  Uses the
+    ``forwards`` convention (every sample workflow defines it), with
+    any normalizer between loader and first layer included."""
+    chain = []
+    forwards = getattr(workflow, "forwards", None)
+    if not forwards:
+        raise Bug("workflow %s has no .forwards chain to export"
+                  % workflow.name)
+    first = forwards[0]
+    norm = getattr(workflow, "normalizer", None)
+    if norm is not None and getattr(first, "input", None) is \
+            getattr(norm, "output", None):
+        chain.append(norm)
+    chain.extend(forwards)
+    return chain
+
+
+def export_workflow(workflow, path):
+    """Writes the inference artifact for a trained workflow."""
+    chain = forward_chain(workflow)
+    units = []
+    weight_arrays = {}
+    for unit in chain:
+        entry, params = _unit_entry(unit)
+        entry["params"] = {}
+        for pname, arr in params.items():
+            key = "%s__%s" % (entry["name"], pname)
+            weight_arrays[key] = arr
+            entry["params"][pname] = key
+        units.append(entry)
+    for entry in units:
+        shape = entry["config"].get("output_sample_shape")
+        if shape is not None and len(shape) > 1:
+            # model.bin flattens dense outputs to n_out; a spatial
+            # dense output feeding a conv/pool would lose geometry in
+            # the native runtime — refuse rather than mis-execute.
+            raise Bug("unit %s has multi-dim dense output shape %s — "
+                      "not representable in the native artifact" %
+                      (entry["name"], shape))
+    in_vec = chain[0].input
+    out_vec = chain[-1].output
+    manifest = {
+        "format": FORMAT_NAME,
+        "version": FORMAT_VERSION,
+        "workflow": type(workflow).__name__,
+        "checksum": workflow.checksum,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "input": {"sample_shape": list(in_vec.shape[1:]),
+                  "dtype": "float32"},
+        "output": {"sample_shape": list(out_vec.shape[1:])},
+        "units": units,
+    }
+    npz_buf = io.BytesIO()
+    numpy.savez(npz_buf, **weight_arrays)
+    blobs = {
+        "manifest.json": dumps_json(manifest, indent=2).encode(),
+        "weights.npz": npz_buf.getvalue(),
+        "model.bin": _pack_binary(manifest, weight_arrays),
+    }
+    with tarfile.open(path, "w:gz") as tar:
+        for name, blob in blobs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    return path
+
+
+# -- model.bin (native runtime format) ----------------------------------
+
+def _pack_str(s):
+    data = s.encode("utf-8")
+    return struct.pack("<H", len(data)) + data
+
+
+def _flat_config(config):
+    """Flattens geometry tuples into scalar keys the native parser
+    reads: padding → pt/pb/pl/pr, sliding → sh/sw."""
+    flat = {}
+    for key, value in config.items():
+        if key == "padding":
+            (pt, pb), (pl, pr) = value
+            flat.update(pad_top=pt, pad_bottom=pb, pad_left=pl,
+                        pad_right=pr)
+        elif key == "sliding":
+            sh, sw = value
+            flat.update(stride_h=sh, stride_w=sw)
+        elif key == "output_sample_shape":
+            flat["n_out"] = int(numpy.prod(value))
+        else:
+            flat[key] = float(value)
+    return flat
+
+
+def _pack_binary(manifest, weight_arrays):
+    out = [MAGIC, struct.pack("<II", FORMAT_VERSION,
+                              len(manifest["units"]))]
+    in_shape = manifest["input"]["sample_shape"]
+    out.append(struct.pack("<I", len(in_shape)))
+    out.append(struct.pack("<%dI" % len(in_shape), *in_shape))
+    for entry in manifest["units"]:
+        out.append(_pack_str(entry["type"]))
+        out.append(_pack_str(entry["name"]))
+        flat = _flat_config(entry["config"])
+        out.append(struct.pack("<I", len(flat)))
+        for key in sorted(flat):
+            out.append(_pack_str(key))
+            out.append(struct.pack("<d", float(flat[key])))
+        params = entry["params"]
+        out.append(struct.pack("<I", len(params)))
+        for pname in sorted(params):
+            arr = weight_arrays[params[pname]]
+            out.append(_pack_str(pname))
+            out.append(struct.pack("<I", arr.ndim))
+            out.append(struct.pack("<%dI" % arr.ndim, *arr.shape))
+            out.append(numpy.ascontiguousarray(
+                arr, dtype=numpy.float32).tobytes())
+    return b"".join(out)
+
+
+# -- execution from the artifact ----------------------------------------
+
+class ExportedModel(object):
+    """Loads an artifact and re-executes its forward chain
+    (the Python mirror of the native runtime)."""
+
+    def __init__(self, path):
+        with tarfile.open(path, "r:gz") as tar:
+            manifest_blob = tar.extractfile("manifest.json").read()
+            weights_blob = tar.extractfile("weights.npz").read()
+        self.manifest = json.loads(manifest_blob)
+        if self.manifest.get("format") != FORMAT_NAME:
+            raise Bug("%s is not a %s artifact" % (path, FORMAT_NAME))
+        if self.manifest.get("version", 0) > FORMAT_VERSION:
+            raise Bug("artifact version %s is newer than this "
+                      "runtime (%d)" % (self.manifest.get("version"),
+                                        FORMAT_VERSION))
+        self.weights = dict(numpy.load(io.BytesIO(weights_blob)))
+        self.units = self.manifest["units"]
+        self.input_shape = tuple(
+            self.manifest["input"]["sample_shape"])
+        self._jit_forward = None
+
+    # ---- numpy reference path (native-runtime mirror) -----------------
+
+    def forward_numpy(self, x):
+        x = numpy.asarray(x, dtype=numpy.float32)
+        x = x.reshape((x.shape[0],) + self.input_shape)
+        for entry in self.units:
+            x = self._run_numpy(entry, x)
+        return x
+
+    def _param(self, entry, name):
+        return self.weights[entry["params"][name]]
+
+    def _run_numpy(self, entry, x):
+        t = entry["type"]
+        cfg = entry["config"]
+        if t == "mean_disp":
+            return (x - self._param(entry, "mean")) * \
+                self._param(entry, "rdisp")
+        if t == "dropout":
+            return x
+        if t.startswith("activation_"):
+            return _ACTS[t.split("activation_")[1]](x)
+        if t.startswith("all2all") or t == "softmax":
+            w = self._param(entry, "weights")
+            y = x.reshape(x.shape[0], -1) @ w
+            if "bias" in entry["params"]:
+                y = y + self._param(entry, "bias")
+            act = {"all2all": "linear", "all2all_tanh": "tanh",
+                   "all2all_relu": "softplus",
+                   "all2all_str": "str", "all2all_sigmoid": "sigmoid",
+                   "softmax": "softmax"}[t]
+            y = _ACTS[act](y)
+            shape = cfg.get("output_sample_shape")
+            if shape:
+                y = y.reshape((x.shape[0],) + tuple(shape))
+            return y
+        if t.startswith("conv"):
+            return self._conv_numpy(entry, x)
+        if t.endswith("pooling"):
+            return self._pool_numpy(entry, x)
+        if t == "norm":
+            return self._lrn_numpy(cfg, x)
+        raise Bug("unknown unit type %r in artifact" % t)
+
+    def _conv_numpy(self, entry, x):
+        cfg = entry["config"]
+        w = self._param(entry, "weights")  # HWIO
+        ky, kx = w.shape[0], w.shape[1]
+        (pt, pb), (pl, pr) = cfg["padding"]
+        sh, sw = cfg["sliding"]
+        xp = numpy.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+        n, H, W, C = xp.shape
+        out_h = (H - ky) // sh + 1
+        out_w = (W - kx) // sw + 1
+        # im2col: patches (n, out_h, out_w, ky*kx*C)
+        cols = numpy.empty((n, out_h, out_w, ky * kx * C),
+                           dtype=numpy.float32)
+        for iy in range(ky):
+            for ix in range(kx):
+                patch = xp[:, iy:iy + sh * out_h:sh,
+                           ix:ix + sw * out_w:sw, :]
+                cols[..., (iy * kx + ix) * C:(iy * kx + ix + 1) * C] \
+                    = patch
+        y = cols @ w.reshape(-1, w.shape[-1])
+        if "bias" in entry["params"]:
+            y = y + self._param(entry, "bias")
+        act = {"conv": "linear", "conv_tanh": "tanh",
+               "conv_relu": "softplus", "conv_str": "str",
+               "conv_sigmoid": "sigmoid"}[entry["type"]]
+        return _ACTS[act](y)
+
+    def _pool_numpy(self, entry, x):
+        cfg = entry["config"]
+        t = entry["type"]
+        ky, kx = int(cfg["ky"]), int(cfg["kx"])
+        sh, sw = cfg["sliding"]
+        (pt, pb), (pl, pr) = cfg["padding"]
+        n, H, W, C = x.shape
+        # Ceil-mode output + tail padding (matches Pooling
+        # _window_padding).
+        out_h = -(-(H + pt + pb - ky) // sh) + 1
+        out_w = -(-(W + pl + pr - kx) // sw) + 1
+        need_h = (out_h - 1) * sh + ky - (H + pt)
+        need_w = (out_w - 1) * sw + kx - (W + pl)
+        pb2, pr2 = max(pb, need_h), max(pr, need_w)
+        if t == "avg_pooling":
+            fill = 0.0
+        else:
+            fill = numpy.nan  # excluded via nan-aware reductions
+        xp = numpy.full((n, H + pt + pb2, W + pl + pr2, C), fill,
+                        dtype=numpy.float32)
+        xp[:, pt:pt + H, pl:pl + W, :] = x
+        y = numpy.empty((n, out_h, out_w, C), dtype=numpy.float32)
+        for oy in range(out_h):
+            for ox in range(out_w):
+                win = xp[:, oy * sh:oy * sh + ky,
+                         ox * sw:ox * sw + kx, :]
+                flat = win.reshape(n, -1, C)
+                if t == "avg_pooling":
+                    y[:, oy, ox] = flat.mean(axis=1)
+                elif t == "maxabs_pooling":
+                    idx = numpy.nanargmax(
+                        numpy.abs(flat), axis=1)
+                    y[:, oy, ox] = numpy.take_along_axis(
+                        flat, idx[:, None, :], axis=1)[:, 0]
+                else:
+                    y[:, oy, ox] = numpy.nanmax(flat, axis=1)
+        if t == "avg_pooling":
+            # Divide by true window population: recompute with count
+            ones = numpy.zeros_like(xp)
+            ones[:, pt:pt + H, pl:pl + W, :] = 1.0
+            for oy in range(out_h):
+                for ox in range(out_w):
+                    win = ones[:, oy * sh:oy * sh + ky,
+                               ox * sw:ox * sw + kx, :]
+                    cnt = win.reshape(n, -1, C).sum(axis=1)
+                    ssum = xp[:, oy * sh:oy * sh + ky,
+                              ox * sw:ox * sw + kx, :] \
+                        .reshape(n, -1, C).sum(axis=1)
+                    y[:, oy, ox] = ssum / numpy.maximum(cnt, 1.0)
+        return y
+
+    @staticmethod
+    def _lrn_numpy(cfg, x):
+        alpha, beta, k, n = (cfg["alpha"], cfg["beta"], cfg["k"],
+                             int(cfg["n"]))
+        c = x.shape[-1]
+        half = n // 2
+        sq = x * x
+        ssum = numpy.zeros_like(x)
+        for j in range(c):
+            lo, hi = max(0, j - half), min(c, j + (n - 1 - half) + 1)
+            ssum[..., j] = sq[..., lo:hi].sum(axis=-1)
+        return x / (k + (alpha / n) * ssum) ** beta
+
+    # ---- jax serving path ---------------------------------------------
+
+    def forward(self, x):
+        """Jitted jax forward (compiles once per batch shape)."""
+        import jax
+        if self._jit_forward is None:
+            self._jit_forward = jax.jit(self._jax_chain)
+        return numpy.asarray(self._jit_forward(
+            numpy.asarray(x, dtype=numpy.float32)))
+
+    def _jax_chain(self, x):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        x = x.reshape((x.shape[0],) + self.input_shape)
+        for entry in self.units:
+            t = entry["type"]
+            cfg = entry["config"]
+            if t == "mean_disp":
+                x = (x - self._param(entry, "mean")) * \
+                    self._param(entry, "rdisp")
+            elif t == "dropout":
+                pass
+            elif t.startswith("activation_"):
+                x = _jax_act(t.split("activation_")[1], x)
+            elif t.startswith("all2all") or t == "softmax":
+                w = self._param(entry, "weights")
+                y = x.reshape(x.shape[0], -1) @ w
+                if "bias" in entry["params"]:
+                    y = y + self._param(entry, "bias")
+                act = {"all2all": "linear", "all2all_tanh": "tanh",
+                       "all2all_relu": "softplus",
+                       "all2all_str": "str",
+                       "all2all_sigmoid": "sigmoid",
+                       "softmax": "softmax"}[t]
+                x = _jax_act(act, y)
+                shape = cfg.get("output_sample_shape")
+                if shape:
+                    x = x.reshape((x.shape[0],) + tuple(shape))
+            elif t.startswith("conv"):
+                w = self._param(entry, "weights")
+                y = lax.conv_general_dilated(
+                    x, w, window_strides=tuple(cfg["sliding"]),
+                    padding=tuple(tuple(p) for p in cfg["padding"]),
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"))
+                if "bias" in entry["params"]:
+                    y = y + self._param(entry, "bias")
+                act = {"conv": "linear", "conv_tanh": "tanh",
+                       "conv_relu": "softplus", "conv_str": "str",
+                       "conv_sigmoid": "sigmoid"}[t]
+                x = _jax_act(act, y)
+            elif t.endswith("pooling"):
+                x = self._jax_pool(t, cfg, x)
+            elif t == "norm":
+                c = x.shape[-1]
+                half = int(cfg["n"]) // 2
+                i = jnp.arange(c)
+                d = i[:, None] - i[None, :]
+                band = ((d >= -half) &
+                        (d <= int(cfg["n"]) - 1 - half)
+                        ).astype(jnp.float32)
+                ssum = jnp.einsum("...c,cd->...d", x * x, band)
+                x = x / (cfg["k"] + (cfg["alpha"] / cfg["n"]) *
+                         ssum) ** cfg["beta"]
+            else:
+                raise Bug("unknown unit type %r" % t)
+        return x
+
+    @staticmethod
+    def _jax_pool(t, cfg, x):
+        import jax.numpy as jnp
+        from jax import lax
+        ky, kx = int(cfg["ky"]), int(cfg["kx"])
+        sh, sw = cfg["sliding"]
+        (pt, pb), (pl, pr) = cfg["padding"]
+        H, W = x.shape[1], x.shape[2]
+        out_h = -(-(H + pt + pb - ky) // sh) + 1
+        out_w = -(-(W + pl + pr - kx) // sw) + 1
+        need_h = (out_h - 1) * sh + ky - (H + pt)
+        need_w = (out_w - 1) * sw + kx - (W + pl)
+        pad = ((0, 0), (pt, max(pb, need_h)),
+               (pl, max(pr, need_w)), (0, 0))
+        dims, strides = (1, ky, kx, 1), (1, sh, sw, 1)
+        if t == "avg_pooling":
+            ssum = lax.reduce_window(x, 0.0, lax.add, dims, strides,
+                                     pad)
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                    dims, strides, pad)
+            return ssum / cnt
+        if t == "maxabs_pooling":
+            hi = lax.reduce_window(x, -jnp.inf, lax.max, dims,
+                                   strides, pad)
+            lo = lax.reduce_window(x, jnp.inf, lax.min, dims,
+                                   strides, pad)
+            return jnp.where(-lo > hi, lo, hi)
+        return lax.reduce_window(x, -jnp.inf, lax.max, dims, strides,
+                                 pad)
+
+
+def _np_softmax(v):
+    e = numpy.exp(v - v.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+_ACTS = {
+    "linear": lambda v: v,
+    "tanh": lambda v: TANH_A * numpy.tanh(TANH_B * v),
+    "softplus": lambda v: numpy.log1p(numpy.exp(-numpy.abs(v))) +
+    numpy.maximum(v, 0.0),
+    "str": lambda v: numpy.maximum(v, 0.0),
+    "sigmoid": lambda v: 1.0 / (1.0 + numpy.exp(-v)),
+    "softmax": _np_softmax,
+}
+
+
+def _jax_act(name, v):
+    import jax
+    import jax.numpy as jnp
+    return {
+        "linear": lambda u: u,
+        "tanh": lambda u: TANH_A * jnp.tanh(TANH_B * u),
+        "softplus": jax.nn.softplus,
+        "str": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "softmax": lambda u: jax.nn.softmax(u, axis=-1),
+    }[name](v)

@@ -32,7 +32,7 @@ from ..json_encoders import dump_json
 from ..launcher import Launcher
 from ..logger import Logger
 from ..workflow import Workflow
-from .core import Population, apply_genes, collect_tunes
+from .core import Population, apply_genes, collect_tunes, _concrete
 
 
 def evaluate_chromosome(module, tunes, genes, seed,
@@ -55,9 +55,8 @@ def evaluate_chromosome_subprocess(module_path, tunes, genes, seed,
     """Same contract via a ``python -m veles_tpu`` child process
     (reference: optimization_workflow.py:260 ``_exec`` — full issue
     isolation at the cost of per-run startup)."""
-    overrides = ["root.%s=%r" % (path, value) for path, value in
-                 zip((p for p, _ in tunes),
-                     (v for v in _concrete_values(tunes, genes)))]
+    overrides = ["root.%s=%r" % (path, _concrete(tune, gene))
+                 for (path, tune), gene in zip(tunes, genes)]
     with tempfile.NamedTemporaryFile(
             mode="r", suffix=".json", delete=False) as tmp:
         result_path = tmp.name
@@ -78,11 +77,6 @@ def evaluate_chromosome_subprocess(module_path, tunes, genes, seed,
             os.unlink(result_path)
         except OSError:
             pass
-
-
-def _concrete_values(tunes, genes):
-    from .core import _concrete
-    return [_concrete(t, g) for (_, t), g in zip(tunes, genes)]
 
 
 class OptimizationWorkflow(Workflow):

@@ -1,0 +1,107 @@
+"""ctypes binding to the native inference runtime.
+
+The C++ library (``native/veles_infer.cc``, libVeles role — reference:
+libVeles/inc/veles/unit.h:41 ``Unit::Execute`` chain) is built on
+demand with the repo Makefile; this wrapper exposes it as
+:class:`NativeModel` with the same ``forward(x)`` contract as
+:class:`veles_tpu.export.ExportedModel`, so parity tests can compare
+the two directly.
+"""
+
+import ctypes
+import os
+import subprocess
+
+import numpy
+
+from .error import Bug
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libveles_infer.so")
+_lib = None
+
+
+def build_native(force=False):
+    """Builds libveles_infer.so via make (g++ + system zlib only).
+    Always invokes make — its dependency check is near-free and keeps
+    the library fresh after source edits."""
+    argv = ["make", "-C", _NATIVE_DIR]
+    if force:
+        argv.insert(1, "-B")
+    proc = subprocess.run(argv, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise Bug("native build failed:\n%s" % proc.stderr[-2000:])
+    return _LIB_PATH
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    build_native()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.vt_load.restype = ctypes.c_void_p
+    lib.vt_load.argtypes = [ctypes.c_char_p]
+    lib.vt_input_size.argtypes = [ctypes.c_void_p]
+    lib.vt_output_size.argtypes = [ctypes.c_void_p]
+    lib.vt_unit_count.argtypes = [ctypes.c_void_p]
+    lib.vt_unit_type.restype = ctypes.c_char_p
+    lib.vt_unit_type.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.vt_forward.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float)]
+    lib.vt_free.argtypes = [ctypes.c_void_p]
+    lib.vt_error.restype = ctypes.c_char_p
+    _lib = lib
+    return lib
+
+
+class NativeModel(object):
+    """An exported artifact loaded by the C++ runtime."""
+
+    def __init__(self, path):
+        self._lib = _load_lib()
+        self._handle = self._lib.vt_load(
+            os.fsencode(os.path.abspath(path)))
+        if not self._handle:
+            raise Bug("native load failed: %s" %
+                      self._lib.vt_error().decode())
+        self.input_size = self._lib.vt_input_size(self._handle)
+        self.output_size = self._lib.vt_output_size(self._handle)
+
+    @property
+    def unit_types(self):
+        n = self._lib.vt_unit_count(self._handle)
+        return [self._lib.vt_unit_type(self._handle, i).decode()
+                for i in range(n)]
+
+    def forward(self, x):
+        x = numpy.ascontiguousarray(x, dtype=numpy.float32)
+        batch = x.shape[0]
+        if x.size != batch * self.input_size:
+            raise Bug("input size mismatch: got %d elements/sample, "
+                      "model wants %d" %
+                      (x.size // batch, self.input_size))
+        out = numpy.empty((batch, self.output_size),
+                          dtype=numpy.float32)
+        rc = self._lib.vt_forward(
+            self._handle,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), batch,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise Bug("native forward failed: %s" %
+                      self._lib.vt_error().decode())
+        return out
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.vt_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,177 @@
+"""RESTful model serving.
+
+Capability parity with the reference REST stack (reference:
+veles/restful_api.py:78-217 — ``RESTfulAPI`` unit exposing a trained
+workflow as HTTP POST /api, base64 or JSON-array inputs, prediction
+out; paired input feed loader/restful.py:52): here serving runs from
+the EXPORTED artifact (export.py) through the jitted jax chain — the
+server compiles the forward once per batch shape and answers from
+device, so the same artifact serves on TPU or CPU and the training
+process does not have to stay alive (the reference kept the whole
+Twisted workflow process up to serve).
+
+Two forms:
+
+* :class:`ModelServer` — standalone: ``ModelServer(artifact).serve()``
+  or ``python -m veles_tpu.serve model.veles.tgz --port 8180``.
+* :class:`RESTfulAPI` — a Unit linked after training: on its first
+  run it exports its workflow's forward chain and starts serving in a
+  background thread (the reference's in-workflow form).
+"""
+
+import base64
+import json
+import threading
+
+import numpy
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .error import Bug
+from .export import ExportedModel, export_workflow
+from .json_encoders import dumps_json
+from .logger import Logger
+from .units import Unit
+
+
+def _decode_input(payload, input_shape):
+    """Accepts {"input": nested lists} or {"input": base64, "shape":
+    [...]} (reference accepted both forms, restful_api.py:137-165)."""
+    if "input" not in payload:
+        raise Bug("request JSON lacks 'input'")
+    raw = payload["input"]
+    if isinstance(raw, str):
+        blob = base64.b64decode(raw)
+        x = numpy.frombuffer(blob, dtype=numpy.float32).copy()
+        shape = payload.get("shape")
+        if shape:
+            x = x.reshape(shape)
+    else:
+        x = numpy.asarray(raw, dtype=numpy.float32)
+    sample = int(numpy.prod(input_shape)) if input_shape else x.size
+    if x.ndim == 1 and sample and x.size == sample:
+        x = x[None]  # single flat sample
+    if x.ndim >= 1 and sample and x.size % sample == 0:
+        return x.reshape(-1, sample)
+    raise Bug("input of %d elements does not tile %d-element samples"
+              % (x.size, sample))
+
+
+class ModelServer(Logger):
+    """Serves an exported artifact over HTTP."""
+
+    def __init__(self, model, host="0.0.0.0", port=8180):
+        super(ModelServer, self).__init__()
+        if isinstance(model, str):
+            model = ExportedModel(model)
+        self.model = model
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                outer.debug("http: " + fmt, *args)
+
+            def _reply(self, code, obj):
+                blob = dumps_json(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                if self.path in ("/", "/health"):
+                    m = outer.model.manifest
+                    self._reply(200, {
+                        "status": "ok",
+                        "workflow": m.get("workflow"),
+                        "units": [u["type"] for u in m["units"]],
+                        "input": m["input"], "output": m["output"],
+                    })
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/api":
+                    self._reply(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length",
+                                                  0))
+                    payload = json.loads(
+                        self.rfile.read(length) or b"{}")
+                    x = _decode_input(
+                        payload,
+                        outer.model.manifest["input"]["sample_shape"])
+                except Exception as e:  # malformed request -> 400
+                    outer.warning("bad /api request: %s", e)
+                    self._reply(400, {"error": str(e)})
+                    return
+                try:
+                    probs = outer.model.forward(x)
+                    flat = probs.reshape(probs.shape[0], -1)
+                    self._reply(200, {
+                        "output": flat,
+                        "labels": numpy.argmax(flat, axis=-1),
+                    })
+                except Exception:  # server-side fault -> 500
+                    outer.exception("/api forward failed")
+                    self._reply(500,
+                                {"error": "internal server error"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+
+    def serve(self):
+        """Blocking serve loop."""
+        self.info("serving model on port %d (POST /api)", self.port)
+        self._httpd.serve_forever()
+
+    def start(self):
+        """Background serve (returns immediately)."""
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="veles-model-server")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class RESTfulAPI(Unit):
+    """In-workflow serving unit (reference: restful_api.py:78): link
+    it after the Decision; when the workflow finishes training it
+    exports the forward chain and serves until stopped."""
+
+    def __init__(self, workflow, **kwargs):
+        super(RESTfulAPI, self).__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.host = kwargs.get("host", "0.0.0.0")
+        self.port = kwargs.get("port", 8180)
+        self.artifact_path = kwargs.get("artifact_path",
+                                        "served.veles.tgz")
+        self.blocking = kwargs.get("blocking", False)
+        self.server = None
+
+    def run(self):
+        if self.server is not None:
+            return
+        export_workflow(self.workflow, self.artifact_path)
+        self.server = ModelServer(self.artifact_path, host=self.host,
+                                  port=self.port)
+        self.port = self.server.port
+        if self.blocking:
+            self.server.serve()
+        else:
+            self.server.start()
+
+    def stop(self):
+        if self.server is not None:
+            self.server.stop()
+            self.server = None
+        super(RESTfulAPI, self).stop()

@@ -1,0 +1,30 @@
+"""``python -m veles_tpu.serve model.veles.tgz [--port N]`` — serve an
+exported artifact over HTTP (reference analogue: running a workflow
+under velescli with the RESTfulAPI unit, restful_api.py:78)."""
+
+import argparse
+import sys
+
+from .restful import ModelServer
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu.serve",
+        description="Serve an exported veles_tpu model over HTTP "
+                    "(POST /api, GET /health)")
+    parser.add_argument("artifact", help="model .veles.tgz path")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8180)
+    args = parser.parse_args(argv)
+    server = ModelServer(args.artifact, host=args.host,
+                         port=args.port)
+    try:
+        server.serve()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
