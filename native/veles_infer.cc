@@ -371,7 +371,10 @@ bool parse_model(const uint8_t *data, size_t size, VtModel *m) {
         p.dims.push_back(c.read<uint32_t>());
         count *= p.dims.back();
       }
-      if (!c.ok || c.p + count * 4 > c.end) {
+      /* Overflow-safe bound: compare against remaining bytes, never
+       * via pointer arithmetic that huge dims could wrap. */
+      if (!c.ok ||
+          count > (uint64_t)(c.end - c.p) / 4) {
         set_error("truncated param data");
         return false;
       }
@@ -446,17 +449,25 @@ bool find_in_tar(const std::vector<uint8_t> &tar,
 extern "C" {
 
 VtModel *vt_load(const char *path) {
-  std::vector<uint8_t> raw;
-  if (!read_file_inflated(path, &raw)) return nullptr;
-  const uint8_t *blob = raw.data();
-  size_t blob_size = raw.size();
-  if (raw.size() < 4 || std::memcmp(raw.data(), "VTPM", 4) != 0) {
-    if (!find_in_tar(raw, "model.bin", &blob, &blob_size))
-      return nullptr;
+  /* No C++ exception may cross the C boundary: a corrupt file that
+   * slips a huge allocation past parsing must surface as NULL +
+   * vt_error, not std::terminate in the host process. */
+  try {
+    std::vector<uint8_t> raw;
+    if (!read_file_inflated(path, &raw)) return nullptr;
+    const uint8_t *blob = raw.data();
+    size_t blob_size = raw.size();
+    if (raw.size() < 4 || std::memcmp(raw.data(), "VTPM", 4) != 0) {
+      if (!find_in_tar(raw, "model.bin", &blob, &blob_size))
+        return nullptr;
+    }
+    auto model = std::make_unique<VtModel>();
+    if (!parse_model(blob, blob_size, model.get())) return nullptr;
+    return model.release();
+  } catch (const std::exception &e) {
+    set_error(std::string("load failed: ") + e.what());
+    return nullptr;
   }
-  auto model = std::make_unique<VtModel>();
-  if (!parse_model(blob, blob_size, model.get())) return nullptr;
-  return model.release();
 }
 
 int vt_input_size(const VtModel *m) { return m ? m->in_size : -1; }
@@ -470,7 +481,7 @@ const char *vt_unit_type(const VtModel *m, int index) {
 }
 
 int vt_forward(const VtModel *m, const float *input, int batch,
-               float *output) {
+               float *output) try {
   if (!m || !input || !output || batch <= 0) {
     set_error("bad arguments");
     return 1;
@@ -508,6 +519,9 @@ int vt_forward(const VtModel *m, const float *input, int batch,
   std::memcpy(output, a.data(),
               (size_t)batch * m->out_size * sizeof(float));
   return 0;
+} catch (const std::exception &e) {
+  set_error(std::string("forward failed: ") + e.what());
+  return 1;
 }
 
 void vt_free(VtModel *m) { delete m; }

@@ -1,0 +1,141 @@
+"""Plotting stack tests (reference capability: veles/graphics_server.py
+PUB/SUB + separate matplotlib client + plotting_units families)."""
+
+import glob
+import os
+import threading
+import time
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.config import root
+from veles_tpu.graphics_client import GraphicsClient
+from veles_tpu.graphics_server import GraphicsServer
+from veles_tpu.launcher import Launcher
+from veles_tpu.memory import Vector
+from veles_tpu.plotting_units import (AccumulatingPlotter, Histogram,
+                                      ImagePlotter, MatrixPlotter,
+                                      MultiHistogram, TableMaxMin,
+                                      ImmediatePlotter, SlaveStats)
+
+
+@pytest.fixture
+def server():
+    srv = GraphicsServer(":0")
+    yield srv
+    srv.stop()
+
+
+def test_pub_sub_roundtrip(server, tmp_path):
+    client = GraphicsClient("localhost:%d" % server.port,
+                            output_dir=str(tmp_path))
+    result = {}
+
+    def run_client():
+        result["rendered"] = client.run(max_payloads=2)
+
+    t = threading.Thread(target=run_client, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while server.subscriber_count == 0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert server.subscriber_count == 1
+    server.publish({"kind": "plot", "name": "curve",
+                    "cls_name": "AccumulatingPlotter",
+                    "data": {"label": "err",
+                             "values": [0.5, 0.3, 0.2]}})
+    server.publish({"kind": "plot", "name": "hist",
+                    "cls_name": "Histogram",
+                    "data": {"counts": [1, 2, 3],
+                             "edges": [0.0, 0.1, 0.2, 0.3],
+                             "name": "weights"}})
+    t.join(timeout=30)
+    assert result.get("rendered") == 2
+    files = sorted(os.path.basename(p)
+                   for p in glob.glob(str(tmp_path / "*.png")))
+    assert files == ["curve.png", "hist.png"]
+
+
+def _render_ok(cls, data):
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    fig = plt.figure()
+    cls.render(data, fig)
+    plt.close(fig)
+
+
+def test_all_families_render():
+    """Every plotter family's render() draws without error."""
+    _render_ok(AccumulatingPlotter,
+               {"label": "x", "values": [3.0, 2.0, 1.0],
+                "fit_poly_power": 1})
+    _render_ok(MatrixPlotter,
+               {"matrix": numpy.arange(9).reshape(3, 3),
+                "name": "confusion"})
+    _render_ok(ImagePlotter,
+               {"images": numpy.random.rand(4, 49)})
+    _render_ok(Histogram,
+               {"counts": numpy.array([1, 5, 2]),
+                "edges": numpy.array([0., 1., 2., 3.]),
+                "name": "w"})
+    _render_ok(MultiHistogram,
+               {"hists": [{"counts": [1, 2],
+                           "edges": [0., 0.5, 1.0]}] * 3})
+    _render_ok(TableMaxMin,
+               {"rows": [{"label": "w0", "max": 1.0, "min": -1.0}]})
+    _render_ok(ImmediatePlotter,
+               {"series": [{"x": [0, 1, 2], "y": [5, 6, 7]}]})
+    _render_ok(SlaveStats, {"workers": []})
+    _render_ok(SlaveStats,
+               {"workers": [{"id": "a/1", "power": 1.0,
+                             "jobs_done": 3, "state": "WORK",
+                             "blacklisted": False}]})
+
+
+def test_plotters_in_mnist_workflow(tmp_path):
+    """Plotter units linked into a real training loop publish live
+    payloads to a subscribed viewer."""
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+    prng.reset()
+    prng.get(0).seed(1234)
+    root.common.graphics.enabled = True
+    try:
+        launcher = Launcher()
+        wf = MnistWorkflow(launcher, max_epochs=3,
+                           learning_rate=0.1)
+        plot_err = AccumulatingPlotter(
+            wf, name="validation error", input=wf.decision,
+            input_field="min_validation_err")
+        plot_err.link_from(wf.decision)
+        plot_err.gate_skip = ~wf.loader.epoch_ended_b \
+            if hasattr(wf.loader, "epoch_ended_b") else False
+        plot_w = Histogram(wf, name="fc0 weights",
+                           input=wf.forwards[0].weights)
+        plot_w.link_from(wf.decision)
+        launcher.initialize()
+        server = launcher.graphics_server
+        assert server is not None
+        client = GraphicsClient("localhost:%d" % server.port,
+                                output_dir=str(tmp_path))
+        t = threading.Thread(target=client.run, daemon=True)
+        t.start()
+        deadline = time.time() + 10
+        while server.subscriber_count == 0 and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        launcher.run()
+        time.sleep(0.5)  # let the viewer drain
+        server.stop()
+        t.join(timeout=10)
+        assert plot_err.last_data is not None
+        assert len(plot_err.values) > 0
+        assert os.path.isfile(
+            str(tmp_path / "validation_error.png"))
+        assert os.path.isfile(str(tmp_path / "fc0_weights.png"))
+    finally:
+        root.common.graphics.enabled = False
+        GraphicsServer._instance = None

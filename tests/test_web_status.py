@@ -1,0 +1,115 @@
+"""Web status dashboard + launcher heartbeat tests (reference
+capability: veles/web_status.py:113-243 + launcher.py:853-886)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.launcher import Launcher
+from veles_tpu.web_status import WebStatusServer
+
+
+@pytest.fixture
+def status_server():
+    srv = WebStatusServer(host="127.0.0.1", port=0,
+                          expiry=30.0).start()
+    yield srv
+    srv.stop()
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d%s" % (port, path),
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path),
+            timeout=30) as resp:
+        return resp.read().decode()
+
+
+def test_update_and_dashboard(status_server):
+    reply = _post(status_server.port, "/update", {
+        "id": "m1", "workflow": "MnistWorkflow",
+        "mode": "standalone", "epoch": 4, "runtime": 12.5,
+        "metrics": {"validation_err": 0.05},
+        "slaves": {"w/1": {"state": "WORK", "jobs_done": 7}},
+    })
+    assert reply["commands"] == []
+    status = json.loads(_get(status_server.port, "/api/status"))
+    assert status["m1"]["workflow"] == "MnistWorkflow"
+    page = _get(status_server.port, "/")
+    assert "MnistWorkflow" in page
+    assert "w/1" in page
+
+
+def test_service_command_roundtrip(status_server):
+    _post(status_server.port, "/update", {"id": "m2",
+                                          "workflow": "X"})
+    _post(status_server.port, "/service",
+          {"master": "m2", "command": "pause", "slave": "w/9"})
+    reply = _post(status_server.port, "/update", {"id": "m2"})
+    assert reply["commands"] == [{"command": "pause",
+                                  "slave": "w/9"}]
+    # consumed — next heartbeat gets nothing
+    reply = _post(status_server.port, "/update", {"id": "m2"})
+    assert reply["commands"] == []
+
+
+def test_unknown_master_command_is_400(status_server):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/service" % status_server.port,
+        data=json.dumps({"master": "ghost",
+                         "command": "pause"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=30)
+    assert e.value.code == 400
+
+
+def test_stale_masters_gc():
+    srv = WebStatusServer(host="127.0.0.1", port=0,
+                          expiry=0.2).start()
+    try:
+        _post(srv.port, "/update", {"id": "old", "workflow": "X"})
+        assert "old" in srv.status()
+        time.sleep(0.4)
+        assert "old" not in srv.status()
+    finally:
+        srv.stop()
+
+
+def test_launcher_heartbeats_reach_dashboard(status_server):
+    """A real training run posts heartbeats with live metrics
+    (retires the round-1/2 vestigial launcher attributes)."""
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+    prng.reset()
+    prng.get(0).seed(1234)
+    launcher = Launcher(
+        status_address="127.0.0.1:%d" % status_server.port,
+        heartbeat_interval=0.1)
+    wf = MnistWorkflow(launcher, max_epochs=4, learning_rate=0.1)
+    launcher.initialize()
+    launcher.run()
+    deadline = time.time() + 5
+    status = {}
+    while time.time() < deadline:
+        status = status_server.status()
+        if status:
+            break
+        time.sleep(0.05)
+    assert len(status) == 1
+    info = next(iter(status.values()))
+    assert info["workflow"] == "MnistWorkflow"
+    assert info["mode"] == "standalone"
+    assert info["epoch"] >= 1
+    assert "validation_err" in info.get("metrics", {})

@@ -426,6 +426,15 @@ class StepCompiler(object):
                 body, (params, states), (blocks, tick_ids))
             return params, states
 
+        # precision_level 2: force full-f32 MXU passes (the TPU
+        # equivalent of the reference's level-2 multipartial
+        # summation, config.py:244-247) — the decorator holds the
+        # context during tracing, where dot precisions bind.
+        if config_get(root.common.engine.precision_level, 0) >= 2:
+            highest = jax.default_matmul_precision("highest")
+            train_step = highest(train_step)
+            infer_step = highest(infer_step)
+            block_step = highest(block_step)
         self._train = jax.jit(train_step, donate_argnums=(0, 1))
         self._infer = jax.jit(infer_step, donate_argnums=(1,))
         self._block = jax.jit(block_step, donate_argnums=(0, 1))

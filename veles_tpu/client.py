@@ -59,6 +59,13 @@ class Client(Logger):
         self.id = None
         self.jobs_done = 0
         self._stop = False
+        #: Pipelined mode (reference --async-slave, client.py:293-341):
+        #: job N+1 is requested BEFORE job N's update is sent, so the
+        #: network round-trip overlaps local compute.
+        self.async_mode = kwargs.get("async_mode", False)
+        #: Periodic power re-measurement (reference: client.py:308-313).
+        self.power_interval = float(kwargs.get("power_interval", 60.0))
+        self._power_measured = 0.0
 
     def stop(self):
         self._stop = True
@@ -81,7 +88,9 @@ class Client(Logger):
                     time.sleep(self.reconnect_delay * attempts)
                     continue
                 attempts = 0
-                if self._job_cycle(chan):
+                cycle = (self._job_cycle_async if self.async_mode
+                         else self._job_cycle)
+                if cycle(chan):
                     return  # orderly bye
             except (OSError, ConnectionError):
                 pass
@@ -92,9 +101,66 @@ class Client(Logger):
 
     # -- phases ------------------------------------------------------------
 
+    def _maybe_remeasure_power(self, chan):
+        """Re-measures computing power every ``power_interval``
+        seconds and reports it (reference: client.py:308-313 — the
+        master's load balancing tracks thermal/contention drift)."""
+        if not self.measure_power:
+            return
+        now = time.time()
+        if now - self._power_measured < self.power_interval:
+            return
+        self._power_measured = now
+        self.power = measure_computing_power()
+        chan.send({"cmd": "power", "power": self.power})
+
+    def _run_job(self, data):
+        result = {}
+
+        def capture(update):
+            result["update"] = update
+
+        self.workflow.do_job(data, None, capture)
+        self.jobs_done += 1
+        return result.get("update")
+
+    def _job_cycle_async(self, chan):
+        """Pipelined cycle (reference: client.py:293-341): the next
+        job request is on the wire while the current job computes, so
+        the worker never idles on master latency.  Replies arrive in
+        request order (one TCP stream, serial server handler), so a
+        simple state walk suffices — no reply-id matching needed."""
+        chan.send({"cmd": "job_request"})
+        while not self._stop:
+            msg = chan.recv()
+            if msg is None:
+                return False
+            cmd = msg.get("cmd")
+            if cmd == "bye":
+                return True
+            if cmd == "update_ack":
+                continue
+            if cmd == "no_job":
+                time.sleep(self.poll_delay)
+                chan.send({"cmd": "job_request"})
+                continue
+            if cmd != "job":
+                continue
+            if self.death_probability and \
+                    random.random() < self.death_probability:
+                self.warning("simulating slave death")
+                os._exit(1)
+            # Pipeline: request N+1 BEFORE computing N.
+            chan.send({"cmd": "job_request"})
+            update = self._run_job(msg["data"])
+            chan.send({"cmd": "update", "data": update})
+            self._maybe_remeasure_power(chan)
+        return True
+
     def _handshake(self, chan):
         if self.measure_power:
             self.power = measure_computing_power()
+            self._power_measured = time.time()
         chan.send({
             "cmd": "handshake",
             "checksum": self.workflow.checksum,
@@ -154,18 +220,12 @@ class Client(Logger):
                 # Chaos testing (reference: client.py:438-442).
                 self.warning("simulating slave death")
                 os._exit(1)
-            result = {}
-
-            def capture(data):
-                result["update"] = data
-
-            self.workflow.do_job(msg["data"], None, capture)
-            self.jobs_done += 1
-            chan.send({"cmd": "update",
-                       "data": result.get("update")})
+            update = self._run_job(msg["data"])
+            chan.send({"cmd": "update", "data": update})
             ack = chan.recv()
             if ack is None:
                 return False
             if ack.get("cmd") == "bye":
                 return True
+            self._maybe_remeasure_power(chan)
         return True

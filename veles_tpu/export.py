@@ -124,6 +124,9 @@ def export_workflow(workflow, path):
         entry["params"] = {}
         for pname, arr in params.items():
             key = "%s__%s" % (entry["name"], pname)
+            if key in weight_arrays:
+                raise Bug("duplicate weight key %r — unit names in "
+                          "the chain must be unique" % key)
             weight_arrays[key] = arr
             entry["params"][pname] = key
         units.append(entry)
@@ -332,13 +335,22 @@ class ExportedModel(object):
                         dtype=numpy.float32)
         xp[:, pt:pt + H, pl:pl + W, :] = x
         y = numpy.empty((n, out_h, out_w, C), dtype=numpy.float32)
+        if t == "avg_pooling":
+            # Sum over zero-padded windows, divided by the true
+            # (unpadded) window population.
+            ones = numpy.zeros_like(xp)
+            ones[:, pt:pt + H, pl:pl + W, :] = 1.0
         for oy in range(out_h):
             for ox in range(out_w):
                 win = xp[:, oy * sh:oy * sh + ky,
                          ox * sw:ox * sw + kx, :]
                 flat = win.reshape(n, -1, C)
                 if t == "avg_pooling":
-                    y[:, oy, ox] = flat.mean(axis=1)
+                    cnt = ones[:, oy * sh:oy * sh + ky,
+                               ox * sw:ox * sw + kx, :] \
+                        .reshape(n, -1, C).sum(axis=1)
+                    y[:, oy, ox] = flat.sum(axis=1) / \
+                        numpy.maximum(cnt, 1.0)
                 elif t == "maxabs_pooling":
                     idx = numpy.nanargmax(
                         numpy.abs(flat), axis=1)
@@ -346,19 +358,6 @@ class ExportedModel(object):
                         flat, idx[:, None, :], axis=1)[:, 0]
                 else:
                     y[:, oy, ox] = numpy.nanmax(flat, axis=1)
-        if t == "avg_pooling":
-            # Divide by true window population: recompute with count
-            ones = numpy.zeros_like(xp)
-            ones[:, pt:pt + H, pl:pl + W, :] = 1.0
-            for oy in range(out_h):
-                for ox in range(out_w):
-                    win = ones[:, oy * sh:oy * sh + ky,
-                               ox * sw:ox * sw + kx, :]
-                    cnt = win.reshape(n, -1, C).sum(axis=1)
-                    ssum = xp[:, oy * sh:oy * sh + ky,
-                              ox * sw:ox * sw + kx, :] \
-                        .reshape(n, -1, C).sum(axis=1)
-                    y[:, oy, ox] = ssum / numpy.maximum(cnt, 1.0)
         return y
 
     @staticmethod

@@ -1,0 +1,70 @@
+"""Shared JSON-over-HTTP scaffolding for the service endpoints
+(REST serving, web status).  One copy of the request/response
+plumbing and the threaded-server lifecycle so fixes land everywhere.
+"""
+
+import json
+import threading
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .json_encoders import dumps_json
+from .logger import Logger
+
+
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Handler base: quiet logging + JSON reply/read helpers.  The
+    owning server sets ``outer`` (a Logger) on the subclass."""
+
+    outer = None
+
+    def log_message(self, fmt, *args):
+        if self.outer is not None:
+            self.outer.debug("http: " + fmt, *args)
+
+    def reply(self, code, obj, ctype="application/json"):
+        if isinstance(obj, (dict, list)):
+            blob = dumps_json(obj).encode()
+        elif isinstance(obj, str):
+            blob = obj.encode()
+        else:
+            blob = obj
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def read_json(self):
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length) or b"{}")
+
+
+class JsonHttpServer(Logger):
+    """Threaded server lifecycle: ``start()`` (background),
+    ``serve()`` (blocking), ``stop()``."""
+
+    def __init__(self, handler_cls, host="0.0.0.0", port=0,
+                 thread_name="veles-http"):
+        super(JsonHttpServer, self).__init__()
+        handler_cls.outer = self
+        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+        self._thread_name = thread_name
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=self._thread_name)
+        self._thread.start()
+        return self
+
+    def serve(self):
+        self._httpd.serve_forever()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -15,6 +15,8 @@ so the launcher's surviving jobs are: mode selection, process-group
 bring-up, lifecycle (initialize → run → stop), heartbeats, and stats.
 """
 
+import json
+import os
 import threading
 import time
 
@@ -50,8 +52,17 @@ class Launcher(Logger):
         self.num_processes = int(kwargs.get("num_processes", 1))
         self.process_id = int(kwargs.get("process_id", 0))
         self._start_time = None
+        # Web-status heartbeats (reference: launcher.py:853-886).
+        # ``status_address`` (or root.common.web.url) turns them on;
+        # queued dashboard commands ride the heartbeat response.
+        self.status_address = kwargs.get(
+            "status_address", config_get(root.common.web.url, None))
+        self.heartbeat_interval = float(kwargs.get(
+            "heartbeat_interval",
+            config_get(root.common.web.interval, 5.0)))
         self._heartbeat_thread = None
-        self.webagg_port = None
+        self._heartbeat_stop = threading.Event()
+        self.graphics_server = None
 
     # -- mode flags (reference API) ----------------------------------------
 
@@ -113,6 +124,11 @@ class Launcher(Logger):
             from .client import Client
             self.client = Client(self.master_address, self.workflow,
                                  **self.slave_kwargs)
+        if config_get(root.common.graphics.enabled, False):
+            from .graphics_server import GraphicsServer
+            self.graphics_server = GraphicsServer.launch()
+        if self.status_address and not self.is_slave:
+            self._start_heartbeats()
         return self
 
     def run(self):
@@ -136,6 +152,7 @@ class Launcher(Logger):
                 self._finished.wait()
         finally:
             self._running.clear()
+            self._heartbeat_stop.set()
             if self.server is not None:
                 self.server.stop()
             self.workflow.print_stats()
@@ -143,7 +160,83 @@ class Launcher(Logger):
     def on_workflow_finished(self):
         self._finished.set()
 
+    # -- heartbeats (reference: launcher.py:853-886) -----------------------
+
+    def _start_heartbeats(self):
+        self._heartbeat_stop.clear()
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="veles-heartbeat")
+        self._heartbeat_thread.start()
+
+    def _heartbeat_loop(self):
+        import urllib.request
+        from .json_encoders import dumps_json
+        from .network_common import machine_id
+        url = self.status_address
+        if not url.startswith("http"):
+            url = "http://" + url
+        url = url.rstrip("/") + "/update"
+        mid = "%s/%d" % (machine_id(), os.getpid())
+        while not self._heartbeat_stop.wait(self.heartbeat_interval):
+            try:
+                req = urllib.request.Request(
+                    url, data=dumps_json(
+                        self.status_payload(mid)).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    reply = json.loads(resp.read())
+                for cmd in reply.get("commands", []):
+                    self._apply_command(cmd)
+            except Exception as e:
+                self.debug("heartbeat failed: %s", e)
+
+    def status_payload(self, mid):
+        wf = self.workflow
+        loader = getattr(wf, "loader", None)
+        decision = getattr(wf, "decision", None)
+        payload = {
+            "id": mid,
+            "workflow": type(wf).__name__ if wf else None,
+            "mode": self.mode,
+            "runtime": self.runtime,
+            "epoch": getattr(loader, "epoch_number", None),
+            "running": self.is_running,
+        }
+        if decision is not None:
+            metrics = {}
+            if getattr(decision, "epoch_metrics", None):
+                for cls, name in enumerate(("test", "validation",
+                                            "train")):
+                    v = decision.epoch_metrics[cls]
+                    if v is not None:
+                        metrics["%s_err" % name] = float(v)
+            payload["metrics"] = metrics
+        if self.server is not None:
+            payload["slaves"] = {
+                sid: {"state": desc.state,
+                      "jobs_done": desc.jobs_done,
+                      "power": desc.power,
+                      "blacklisted": desc.blacklisted}
+                for sid, desc in self.server.slaves.items()}
+        return payload
+
+    def _apply_command(self, cmd):
+        """Dashboard commands arriving via the heartbeat response
+        (reference: web_status.py:197-243 /service)."""
+        name = cmd.get("command")
+        sid = cmd.get("slave")
+        self.info("dashboard command: %s %s", name, sid or "")
+        if name == "stop":
+            self.stop()
+        elif self.server is not None and sid:
+            if name == "pause":
+                self.server.pause_slave(sid)
+            elif name == "resume":
+                self.server.resume_slave(sid)
+
     def stop(self):
+        self._heartbeat_stop.set()
         if self.server is not None:
             self.server.stop()
         if self.client is not None:

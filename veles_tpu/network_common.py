@@ -88,11 +88,14 @@ def send_message(sock, obj, secret=None, nonce=b"", seq=None):
     sock.sendall(_HEADER.pack(len(payload), flags) + payload)
 
 
-def recv_message(sock, secret=None, nonce=b"", seq=None):
+def recv_message(sock, secret=None, nonce=b"", seq=None, loads=None):
     """Receives one framed message; None on orderly close or (with
     ``secret``) on authentication failure — callers treat both as a
     dead peer and drop the connection.  ``seq`` is the sequence number
-    the frame MUST carry (replayed or reordered frames fail the MAC)."""
+    the frame MUST carry (replayed or reordered frames fail the MAC).
+    ``loads`` substitutes the deserializer — receivers of
+    UNAUTHENTICATED streams (graphics viewers) pass a restricted
+    unpickler so a hostile peer cannot smuggle arbitrary callables."""
     header = _recv_exact(sock, _HEADER.size)
     if header is None:
         return None
@@ -112,7 +115,7 @@ def recv_message(sock, secret=None, nonce=b"", seq=None):
             return None
     if flags & _FLAG_GZIP:
         payload = gzip.decompress(payload)
-    return pickle.loads(payload)
+    return (loads or pickle.loads)(payload)
 
 
 class Channel(object):

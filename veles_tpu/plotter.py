@@ -1,0 +1,58 @@
+"""Plotter unit base.
+
+Capability parity with the reference plotter (reference:
+veles/plotter.py:48 ``Plotter`` — a unit that, when its gate fires,
+ships itself to the graphics server for a separate matplotlib process
+to redraw).  Here a plotter ships ``(type(self), self.plot_data())``
+— see graphics_server docstring for why payloads are (class, data)
+pairs rather than pickled units.
+
+Subclasses implement ``plot_data() -> dict`` (host-side snapshot of
+the linked values) and ``render(data, fig)`` (a staticmethod drawing
+onto a matplotlib figure — executed in the viewer process, never in
+the training process).
+"""
+
+from .config import root, get as config_get
+from .units import Unit
+
+
+class Plotter(Unit):
+    """Base plotter (reference: plotter.py:48)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(Plotter, self).__init__(workflow, **kwargs)
+        self.view_group = "PLOTTER"
+        self.clear_plot = kwargs.get("clear_plot", False)
+        self.redraw_plot = kwargs.get("redraw_plot", True)
+        self.last_data = None
+
+    @property
+    def graphics_server(self):
+        launcher = getattr(self.workflow, "launcher", None)
+        return getattr(launcher, "graphics_server", None)
+
+    def plot_data(self):
+        raise NotImplementedError()
+
+    @staticmethod
+    def render(data, fig):
+        raise NotImplementedError()
+
+    def run(self):
+        if not config_get(root.common.graphics.enabled, True):
+            return
+        self.last_data = self.plot_data()
+        server = self.graphics_server
+        if server is not None:
+            server.publish({
+                "kind": "plot",
+                "name": self.name,
+                # By NAME, not class object: the viewer resolves it
+                # against its own whitelist of plotter families, so
+                # payloads cannot smuggle classes.
+                "cls_name": type(self).__name__,
+                "data": self.last_data,
+            })

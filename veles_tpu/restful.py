@@ -20,17 +20,12 @@ Two forms:
 """
 
 import base64
-import json
-import threading
 
 import numpy
 
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
 from .error import Bug
 from .export import ExportedModel, export_workflow
-from .json_encoders import dumps_json
-from .logger import Logger
+from .http_common import JsonHttpServer, JsonRequestHandler
 from .units import Unit
 
 
@@ -57,90 +52,60 @@ def _decode_input(payload, input_shape):
               % (x.size, sample))
 
 
-class ModelServer(Logger):
+class ModelServer(JsonHttpServer):
     """Serves an exported artifact over HTTP."""
 
     def __init__(self, model, host="0.0.0.0", port=8180):
-        super(ModelServer, self).__init__()
         if isinstance(model, str):
             model = ExportedModel(model)
         self.model = model
-        outer = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, fmt, *args):
-                outer.debug("http: " + fmt, *args)
-
-            def _reply(self, code, obj):
-                blob = dumps_json(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(blob)))
-                self.end_headers()
-                self.wfile.write(blob)
-
+        class Handler(JsonRequestHandler):
             def do_GET(self):
+                outer = self.outer
                 if self.path in ("/", "/health"):
                     m = outer.model.manifest
-                    self._reply(200, {
+                    self.reply(200, {
                         "status": "ok",
                         "workflow": m.get("workflow"),
                         "units": [u["type"] for u in m["units"]],
                         "input": m["input"], "output": m["output"],
                     })
                 else:
-                    self._reply(404, {"error": "not found"})
+                    self.reply(404, {"error": "not found"})
 
             def do_POST(self):
+                outer = self.outer
                 if self.path != "/api":
-                    self._reply(404, {"error": "not found"})
+                    self.reply(404, {"error": "not found"})
                     return
                 try:
-                    length = int(self.headers.get("Content-Length",
-                                                  0))
-                    payload = json.loads(
-                        self.rfile.read(length) or b"{}")
                     x = _decode_input(
-                        payload,
+                        self.read_json(),
                         outer.model.manifest["input"]["sample_shape"])
                 except Exception as e:  # malformed request -> 400
                     outer.warning("bad /api request: %s", e)
-                    self._reply(400, {"error": str(e)})
+                    self.reply(400, {"error": str(e)})
                     return
                 try:
                     probs = outer.model.forward(x)
                     flat = probs.reshape(probs.shape[0], -1)
-                    self._reply(200, {
+                    self.reply(200, {
                         "output": flat,
                         "labels": numpy.argmax(flat, axis=-1),
                     })
                 except Exception:  # server-side fault -> 500
                     outer.exception("/api forward failed")
-                    self._reply(500,
-                                {"error": "internal server error"})
+                    self.reply(500,
+                               {"error": "internal server error"})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._httpd.server_address[1]
-        self._thread = None
+        super(ModelServer, self).__init__(
+            Handler, host=host, port=port,
+            thread_name="veles-model-server")
 
     def serve(self):
-        """Blocking serve loop."""
         self.info("serving model on port %d (POST /api)", self.port)
-        self._httpd.serve_forever()
-
-    def start(self):
-        """Background serve (returns immediately)."""
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True,
-                                        name="veles-model-server")
-        self._thread.start()
-        return self
-
-    def stop(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        super(ModelServer, self).serve()
 
 
 class RESTfulAPI(Unit):

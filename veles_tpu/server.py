@@ -82,6 +82,11 @@ class Server(Logger):
             workflow.checksum)
         #: jobs handed out but not yet answered, per slave id
         self._outstanding = {}
+        #: Respawn hook: ``respawn(desc)`` relaunches a dropped
+        #: worker (reference: server.py:637-655).
+        self.respawn = kwargs.get("respawn")
+        self.max_respawns = int(kwargs.get("max_respawns", 10))
+        self._respawn_counts = {}
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name="veles-server-accept")
@@ -265,6 +270,10 @@ class Server(Logger):
                 if self._maybe_finished():
                     chan.send({"cmd": "bye"})
                     return
+            elif cmd == "power":
+                # Periodic re-measurement from the worker (reference:
+                # server.py:531) keeps load balancing honest.
+                desc.power = float(msg.get("power", desc.power))
             elif cmd == "bye":
                 return
 
@@ -325,9 +334,40 @@ class Server(Logger):
 
     def _drop(self, desc):
         """Connection lost → requeue in-flight work
-        (reference: server.py:315-338)."""
+        (reference: server.py:315-338), then optionally respawn the
+        worker."""
         with self._lock:
             self._slaves.pop(desc.id, None)
             self._outstanding.pop(desc.id, None)
             self.workflow.drop_slave(desc.id)
         self.info("worker %s dropped", desc.id)
+        self._maybe_respawn(desc)
+
+    def _maybe_respawn(self, desc):
+        """Relaunches a dropped worker with exponential backoff
+        (reference: server.py:637-655 respawned over SSH; here the
+        hook is a callable — local subprocess, SSH, k8s, whatever the
+        deployment uses — so policy stays out of the protocol)."""
+        if self.respawn is None or self._stop.is_set():
+            return
+        mid = desc.mid or "unknown"
+        count = self._respawn_counts.get(mid, 0)
+        if count >= self.max_respawns:
+            self.warning("worker machine %s exceeded %d respawns — "
+                         "giving up on it", mid, self.max_respawns)
+            return
+        self._respawn_counts[mid] = count + 1
+        delay = min(2.0 ** count * 0.5, 30.0)
+
+        def relaunch():
+            if self._stop.wait(delay):
+                return
+            self.info("respawning worker for %s (attempt %d)", mid,
+                      count + 1)
+            try:
+                self.respawn(desc)
+            except Exception:
+                self.exception("respawn hook failed for %s", mid)
+
+        threading.Thread(target=relaunch, daemon=True,
+                         name="veles-respawn").start()

@@ -1,0 +1,181 @@
+"""Web status dashboard.
+
+Capability parity with the reference status server (reference:
+veles/web_status.py:113-243 — Tornado server receiving master status
+POSTs from launcher heartbeats launcher.py:853-886, UI listing running
+workflows + their workers, ``/service`` pause/resume commands):
+a stdlib ThreadingHTTPServer with
+
+* ``POST /update`` — launchers post heartbeat JSON; the response
+  carries any queued commands for that master (the command round-trip
+  rides the heartbeat instead of a callback socket — no inbound
+  connection to the master needed);
+* ``GET /`` — HTML dashboard of running workflows and their workers;
+* ``GET /api/status`` — the raw JSON;
+* ``POST /service`` — queue ``pause``/``resume`` (optionally
+  per-worker) for a master.
+
+Stale masters (no heartbeat for ``expiry`` seconds) are dropped, the
+reference's garbage-collection behavior.
+"""
+
+import json
+import threading
+import time
+
+from .http_common import JsonHttpServer, JsonRequestHandler
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu status</title>
+<meta http-equiv="refresh" content="5">
+<style>
+body {{ font-family: sans-serif; margin: 2em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #999; padding: 4px 10px; }}
+th {{ background: #eee; }}
+.dead {{ color: #999; }}
+</style></head>
+<body><h1>veles_tpu — running workflows</h1>
+{rows}
+<p>{count} master(s); page refreshes every 5 s.</p>
+</body></html>"""
+
+
+class WebStatusServer(JsonHttpServer):
+    """The dashboard server (reference: web_status.py:113)."""
+
+    def __init__(self, host="0.0.0.0", port=8090, expiry=30.0):
+        self.expiry = expiry
+        self._masters = {}  # id -> {payload, received}
+        self._commands = {}  # id -> [command dicts]
+        self._lock = threading.Lock()
+
+        class Handler(JsonRequestHandler):
+            def do_GET(self):
+                outer = self.outer
+                if self.path in ("/", "/index.html"):
+                    self.reply(200, outer.render_page(),
+                               "text/html")
+                elif self.path == "/api/status":
+                    self.reply(200, outer.status())
+                else:
+                    self.reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                outer = self.outer
+                try:
+                    payload = self.read_json()
+                except ValueError:
+                    self.reply(400, {"error": "bad json"})
+                    return
+                if self.path == "/update":
+                    self.reply(200,
+                               {"commands": outer.update(payload)})
+                elif self.path == "/service":
+                    try:
+                        outer.queue_command(payload)
+                        self.reply(200, {"status": "queued"})
+                    except KeyError as e:
+                        self.reply(400, {"error": str(e)})
+                else:
+                    self.reply(404, {"error": "not found"})
+
+        super(WebStatusServer, self).__init__(
+            Handler, host=host, port=port,
+            thread_name="veles-web-status")
+
+    # -- state -------------------------------------------------------------
+
+    def update(self, payload):
+        """Records a heartbeat; returns + clears queued commands."""
+        mid = payload.get("id")
+        if not mid:
+            return []
+        with self._lock:
+            self._masters[mid] = {"payload": payload,
+                                  "received": time.time()}
+            self._gc_locked()
+            return self._commands.pop(mid, [])
+
+    def queue_command(self, payload):
+        mid = payload["master"]
+        command = payload["command"]
+        if command not in ("pause", "resume", "stop"):
+            raise KeyError("unknown command %r" % command)
+        with self._lock:
+            if mid not in self._masters:
+                raise KeyError("unknown master %r" % mid)
+            self._commands.setdefault(mid, []).append(
+                {"command": command,
+                 "slave": payload.get("slave")})
+
+    def status(self):
+        with self._lock:
+            self._gc_locked()
+            now = time.time()
+            return {mid: dict(entry["payload"],
+                              age=now - entry["received"])
+                    for mid, entry in self._masters.items()}
+
+    def _gc_locked(self):
+        cutoff = time.time() - self.expiry
+        for mid in [m for m, e in self._masters.items()
+                    if e["received"] < cutoff]:
+            del self._masters[mid]
+            self._commands.pop(mid, None)
+
+    def render_page(self):
+        status = self.status()
+        rows = []
+        for mid, info in sorted(status.items()):
+            workers = info.get("slaves", {})
+            wtable = "".join(
+                "<tr><td>%s</td><td>%s</td><td>%s</td></tr>" %
+                (sid, w.get("state"), w.get("jobs_done"))
+                for sid, w in workers.items())
+            rows.append(
+                "<h2>%s <small>(%s)</small></h2>"
+                "<table><tr><th>mode</th><td>%s</td></tr>"
+                "<tr><th>epoch</th><td>%s</td></tr>"
+                "<tr><th>runtime</th><td>%.0f s</td></tr>"
+                "<tr><th>metrics</th><td>%s</td></tr></table>" %
+                (info.get("workflow", "?"), mid,
+                 info.get("mode", "?"), info.get("epoch", "?"),
+                 info.get("runtime", 0.0),
+                 json.dumps(info.get("metrics", {}))) +
+                ("<h3>workers</h3><table><tr><th>id</th><th>state"
+                 "</th><th>jobs</th></tr>%s</table>" % wtable
+                 if workers else ""))
+        return _PAGE.format(rows="\n".join(rows) or
+                            "<p>nothing running.</p>",
+                            count=len(status))
+
+    # -- lifecycle: start/serve/stop inherited from JsonHttpServer ---------
+
+    def start(self):
+        super(WebStatusServer, self).start()
+        self.info("web status on port %d", self.port)
+        return self
+
+    def serve(self):
+        self.info("web status on port %d", self.port)
+        super(WebStatusServer, self).serve()
+
+
+def main(argv=None):
+    import argparse
+    parser = argparse.ArgumentParser(prog="veles_tpu.web_status")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8090)
+    args = parser.parse_args(argv)
+    server = WebStatusServer(host=args.host, port=args.port)
+    try:
+        server.serve()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

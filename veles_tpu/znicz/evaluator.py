@@ -37,11 +37,29 @@ class EvaluatorBase(TracedUnit):
         self.minibatch_class_vec = None  # linked from loader
         self.epoch_acc = Vector(numpy.zeros((3, 4),
                                             dtype=numpy.float32))
+        # Kahan carry for compensated epoch sums (precision_level>=1;
+        # the reference's levels 1/2 were compensated/multipartial
+        # summation in its OpenCL kernels, config.py:244-247).
+        self.epoch_acc_c = Vector(numpy.zeros((3, 4),
+                                              dtype=numpy.float32))
         self.demand("input")
+
+    @staticmethod
+    def _compensated():
+        from ..config import root, get as config_get
+        return config_get(root.common.engine.precision_level, 0) >= 1
 
     @property
     def tstate(self):
-        return {"epoch_acc": self.epoch_acc}
+        state = {"epoch_acc": self.epoch_acc}
+        if self._compensated():
+            acc_c = getattr(self, "epoch_acc_c", None)
+            if acc_c is None:  # evaluator from a pre-Kahan snapshot
+                acc_c = Vector(numpy.zeros((3, 4),
+                                           dtype=numpy.float32))
+                self.epoch_acc_c = acc_c
+            state["epoch_acc_c"] = acc_c
+        return state
 
     def _accumulate(self, read, state, err_sum, n_valid, loss):
         import jax.numpy as jnp
@@ -52,6 +70,17 @@ class EvaluatorBase(TracedUnit):
         # whole row, including the tick counter, by validity.
         valid = (n_valid > 0).astype(jnp.float32)
         row = jnp.stack([err_sum, n_valid, loss * valid, valid])
+        if "epoch_acc_c" in state:
+            # Kahan step: the carry row absorbs the low-order bits a
+            # plain f32 add would drop over a long epoch.
+            acc = state["epoch_acc"][cls]
+            carry = state["epoch_acc_c"][cls]
+            y = row - carry
+            t = acc + y
+            new_carry = (t - acc) - y
+            return {"epoch_acc": state["epoch_acc"].at[cls].set(t),
+                    "epoch_acc_c":
+                        state["epoch_acc_c"].at[cls].set(new_carry)}
         return {"epoch_acc":
                 state["epoch_acc"].at[cls].add(row)}
 
@@ -64,6 +93,10 @@ class EvaluatorBase(TracedUnit):
     def reset_epoch_acc(self, cls):
         self.epoch_acc.map_write()
         self.epoch_acc.mem[cls] = 0.0
+        acc_c = getattr(self, "epoch_acc_c", None)  # absent in old
+        if acc_c:                                   # snapshots
+            acc_c.map_write()
+            acc_c.mem[cls] = 0.0
 
 
 class EvaluatorSoftmax(EvaluatorBase):

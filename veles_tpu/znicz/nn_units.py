@@ -132,11 +132,15 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
 
     def generate_data_for_slave(self, slave=None):
         """Ships current trainables; remembers what each worker got so
-        its update can be applied as a delta."""
+        its update can be applied as a delta.  A FIFO per worker:
+        pipelined (async) workers hold several jobs in flight, and
+        replies come back in serve order on the one TCP stream — a
+        single slot would mis-base job N's delta and turn job N+1's
+        into an absolute overwrite."""
         if not self.trainables:
             return None
         arrays = self._trainable_arrays()
-        self._shipped_[slave] = arrays
+        self._shipped_.setdefault(slave, []).append(arrays)
         return arrays
 
     def apply_data_from_master(self, data):
@@ -158,7 +162,10 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
         current values as (theirs − shipped)."""
         if not data:
             return
-        base = self._shipped_.pop(slave, None)
+        bases = self._shipped_.get(slave)
+        base = bases.pop(0) if bases else None
+        if bases is not None and not bases:
+            self._shipped_.pop(slave, None)
         for attr, arr in data.items():
             vec = self.trainables.get(attr)
             if vec is None:
