@@ -1,0 +1,304 @@
+"""Loader long-tail tests: audio windows, image-MSE pairs,
+background/padding handling, WebHDFS text streaming
+(reference capabilities: loader/libsndfile*.py, image_mse.py,
+image.py padding, hdfs_loader.py)."""
+
+import http.server
+import json
+import os
+import threading
+import wave
+
+import numpy
+import pytest
+
+from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.loader.base import TRAIN, VALID
+
+
+def _write_wav(path, samples, rate=8000):
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(
+            (numpy.clip(samples, -1, 1) * 32767).astype("<i2")
+            .tobytes())
+
+
+def _write_png(path, arr):
+    from PIL import Image
+    Image.fromarray(arr.astype(numpy.uint8)).save(str(path))
+
+
+class TestAudioLoader:
+    def test_windows_and_labels(self, tmp_path):
+        from veles_tpu.loader.audio import AudioFileLoader
+
+        for label in ("hum", "hiss"):
+            d = tmp_path / label
+            d.mkdir()
+            t = numpy.linspace(0, 1, 8000)
+            sig = numpy.sin(2 * numpy.pi *
+                            (440 if label == "hum" else 3000) * t)
+            _write_wav(d / "a.wav", sig)
+        loader = AudioFileLoader(
+            DummyWorkflow(), minibatch_size=4, window_size=2000,
+            train_paths=[str(tmp_path / "hum"),
+                         str(tmp_path / "hiss")])
+        loader.load_data()
+        # 8000 samples / 2000 window = 4 windows per file, 2 files.
+        assert loader.class_lengths == [0, 0, 8]
+        assert loader.original_data.mem.shape == (8, 2000)
+        assert loader.samplerate == 8000
+        assert set(loader.original_labels.mem.tolist()) == {0, 1}
+
+    def test_overlapping_windows(self, tmp_path):
+        from veles_tpu.loader.audio import AudioFileLoader
+
+        _write_wav(tmp_path / "x.wav", numpy.zeros(4000))
+        loader = AudioFileLoader(
+            DummyWorkflow(), window_size=2000, window_step=1000,
+            train_paths=[(str(tmp_path / "x.wav"), 0)])
+        loader.load_data()
+        assert loader.class_lengths[TRAIN] == 3  # 0,1000,2000 starts
+
+    def test_short_file_zero_padded(self, tmp_path):
+        from veles_tpu.loader.audio import AudioFileLoader
+
+        _write_wav(tmp_path / "s.wav", numpy.ones(100) * 0.5)
+        loader = AudioFileLoader(
+            DummyWorkflow(), window_size=1000,
+            train_paths=[(str(tmp_path / "s.wav"), 0)])
+        loader.load_data()
+        win = loader.original_data.mem[0]
+        assert win.shape == (1000,)
+        assert abs(win[:100].mean() - 0.5) < 0.01
+        assert numpy.all(win[100:] == 0)
+
+    def test_wave_decode_roundtrip(self, tmp_path):
+        from veles_tpu.loader.audio import decode_audio
+
+        sig = numpy.sin(numpy.linspace(0, 20, 500))
+        _write_wav(tmp_path / "r.wav", sig, rate=16000)
+        data, rate = decode_audio(str(tmp_path / "r.wav"))
+        assert rate == 16000
+        assert data.shape == (500, 1)
+        numpy.testing.assert_allclose(data[:, 0], sig, atol=1e-3)
+
+
+class TestImagePaddingAndMSE:
+    def test_keep_aspect_ratio_pads_background(self, tmp_path):
+        from veles_tpu.loader.image import FileImageLoader
+
+        # 40x20 white image into a 32x32 target with gray background.
+        _write_png(tmp_path / "wide.png",
+                   numpy.full((20, 40, 3), 255))
+        loader = FileImageLoader(
+            DummyWorkflow(), size=(32, 32), keep_aspect_ratio=True,
+            background_color=128,
+            train_paths=[(str(tmp_path / "wide.png"), 0)])
+        loader.load_data()
+        img = loader.original_data.mem[0]
+        assert img.shape == (32, 32, 3)
+        assert img[16, 16, 0] == 255   # center: the image
+        assert img[0, 16, 0] == 128    # top band: background
+        assert img[31, 16, 0] == 128   # bottom band: background
+
+    def test_crop_larger_than_image_pads(self, tmp_path):
+        from veles_tpu.loader.image import FileImageLoader
+
+        _write_png(tmp_path / "tiny.png",
+                   numpy.full((8, 8, 3), 200))
+        loader = FileImageLoader(
+            DummyWorkflow(), size=(8, 8), crop=(16, 16),
+            background_color=7,
+            train_paths=[(str(tmp_path / "tiny.png"), 0)])
+        loader.load_data()
+        img = loader.original_data.mem[0]
+        assert img.shape == (16, 16, 3)
+        assert img[8, 8, 0] == 200
+        assert img[0, 0, 0] == 7
+
+    def test_mse_targets_paired_by_filename(self, tmp_path):
+        from veles_tpu.loader.image import FileImageMSELoader
+
+        inputs = tmp_path / "in"
+        targets = tmp_path / "gt"
+        inputs.mkdir()
+        targets.mkdir()
+        for i in range(3):
+            _write_png(inputs / ("img%d.png" % i),
+                       numpy.full((8, 8, 3), 50 + i))
+            _write_png(targets / ("img%d.png" % i),
+                       numpy.full((8, 8, 3), 150 + i))
+        loader = FileImageMSELoader(
+            DummyWorkflow(), size=(8, 8),
+            train_paths=[str(inputs)],
+            target_paths=str(targets))
+        loader.load_data()
+        assert loader.original_data.mem.shape == (3, 8, 8, 3)
+        assert loader.original_targets.mem.shape == (3, 8, 8, 3)
+        for i in range(3):
+            assert loader.original_data.mem[i, 0, 0, 0] == 50 + i
+            assert loader.original_targets.mem[i, 0, 0, 0] == 150 + i
+
+    def test_mse_missing_target_raises(self, tmp_path):
+        from veles_tpu.error import BadFormatError
+        from veles_tpu.loader.image import FileImageMSELoader
+
+        inputs = tmp_path / "in"
+        inputs.mkdir()
+        (tmp_path / "gt").mkdir()
+        _write_png(inputs / "a.png", numpy.zeros((4, 4, 3)))
+        loader = FileImageMSELoader(
+            DummyWorkflow(), size=(4, 4),
+            train_paths=[str(inputs)],
+            target_paths=str(tmp_path / "gt"))
+        with pytest.raises(BadFormatError):
+            loader.load_data()
+
+
+class _WebHDFSStub(http.server.BaseHTTPRequestHandler):
+    CONTENT = b"line one\nline two\nline three\n"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        if "op=GETFILESTATUS" in self.path:
+            blob = json.dumps({"FileStatus": {
+                "length": len(self.CONTENT),
+                "type": "FILE"}}).encode()
+            ctype = "application/json"
+        elif "op=OPEN" in self.path:
+            blob = self.CONTENT
+            ctype = "application/octet-stream"
+        elif "op=LISTSTATUS" in self.path:
+            blob = json.dumps({"FileStatuses": {"FileStatus": [
+                {"pathSuffix": "data.txt"}]}}).encode()
+            ctype = "application/json"
+        else:
+            self.send_response(400)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+
+class TestHDFS:
+    @pytest.fixture
+    def namenode(self):
+        httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), _WebHDFSStub)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield "127.0.0.1:%d" % httpd.server_address[1]
+        httpd.shutdown()
+        httpd.server_close()
+
+    def test_client_ops(self, namenode):
+        from veles_tpu.loader.hdfs_loader import WebHDFSClient
+
+        client = WebHDFSClient(namenode)
+        assert client.stat("/data.txt")["type"] == "FILE"
+        assert client.list("/") == ["data.txt"]
+        assert b"line two" in client.open("/data.txt")
+
+    def test_text_loader_chunks_until_finished(self, namenode):
+        from veles_tpu.loader.hdfs_loader import HDFSTextLoader
+
+        loader = HDFSTextLoader(DummyWorkflow(), file="/data.txt",
+                                address=namenode, chunk=2)
+        loader.initialize()
+        loader.run()
+        assert loader.output == ["line one", "line two"]
+        assert not bool(loader.finished)
+        loader.run()
+        assert loader.output[0] == "line three"
+        assert bool(loader.finished)
+
+
+class TestReviewRegressions:
+    def test_short_stereo_file_mono_false(self, tmp_path):
+        from veles_tpu.loader.audio import AudioFileLoader
+
+        with wave.open(str(tmp_path / "st.wav"), "wb") as w:
+            w.setnchannels(2)
+            w.setsampwidth(2)
+            w.setframerate(8000)
+            frames = (numpy.ones((50, 2)) * 16000).astype("<i2")
+            w.writeframes(frames.tobytes())
+        loader = AudioFileLoader(
+            DummyWorkflow(), window_size=200, mono=False,
+            train_paths=[(str(tmp_path / "st.wav"), 0)])
+        loader.load_data()
+        assert loader.original_data.mem.shape == (1, 200, 2)
+        assert numpy.all(loader.original_data.mem[0, 50:] == 0)
+
+    def test_mse_targets_share_input_normalization(self, tmp_path):
+        from veles_tpu.loader.image import FileImageMSELoader
+
+        inputs = tmp_path / "in2"
+        targets = tmp_path / "gt2"
+        inputs.mkdir()
+        targets.mkdir()
+        ramp = numpy.arange(48).reshape(4, 4, 3) * 5.0
+        _write_png(inputs / "a.png", ramp)
+        _write_png(targets / "a.png", 235 - ramp)
+        loader = FileImageMSELoader(
+            DummyWorkflow(), size=(4, 4),
+            normalization_type="linear",
+            train_paths=[str(inputs)], target_paths=str(targets))
+        loader.load_data()
+        # linear normalization maps inputs to [-1,1]; targets must
+        # ride the same transform, not stay at raw 0-255 scale.
+        assert loader.original_data.mem.max() <= 1.001
+        assert loader.original_targets.mem.max() <= 1.1
+
+    def test_mse_mirror_rejected_at_construction(self, tmp_path):
+        from veles_tpu.error import BadFormatError
+        from veles_tpu.loader.image import FileImageMSELoader
+
+        with pytest.raises(BadFormatError):
+            FileImageMSELoader(DummyWorkflow(), mirror=True,
+                               target_paths=str(tmp_path))
+
+    def test_hdfs_streaming_chunks(self, tmp_path):
+        from veles_tpu.loader.hdfs_loader import WebHDFSClient
+
+        class Stub(_WebHDFSStub):
+            CONTENT = b"0123456789" * 10
+
+            def do_GET(self):
+                import urllib.parse
+                q = dict(urllib.parse.parse_qsl(
+                    urllib.parse.urlparse(self.path).query))
+                if q.get("op") == "OPEN":
+                    off = int(q.get("offset", 0))
+                    length = int(q.get("length", 1 << 30))
+                    blob = self.CONTENT[off:off + length]
+                    self.send_response(200)
+                    self.send_header("Content-Length",
+                                     str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                else:
+                    _WebHDFSStub.do_GET(self)
+
+        httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                Stub)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            client = WebHDFSClient(
+                "127.0.0.1:%d" % httpd.server_address[1])
+            chunks = list(client.iter_chunks("/f", chunk_bytes=32))
+            assert b"".join(chunks) == Stub.CONTENT
+            assert len(chunks) == 4  # 32+32+32+4
+        finally:
+            httpd.shutdown()
+            httpd.server_close()

@@ -1,0 +1,238 @@
+"""Service-layer tests: forge registry, publishing backends,
+interactive shell unit, frontend generator (reference capabilities:
+veles/forge/, veles/publishing/, veles/interaction.py,
+veles/scripts/generate_frontend.py)."""
+
+import json
+import os
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.error import BadFormatError
+from veles_tpu.launcher import Launcher
+
+
+# ---------------------------------------------------------------- forge
+
+@pytest.fixture
+def forge(tmp_path):
+    from veles_tpu.forge import ForgeServer
+    server = ForgeServer(str(tmp_path / "registry"),
+                         host="127.0.0.1", port=0).start()
+    yield server
+    server.stop()
+
+
+def _make_package(tmp_path, name="mnist-fc", extra=None):
+    pkg = tmp_path / name
+    pkg.mkdir(exist_ok=True)
+    manifest = {"name": name, "workflow": "workflow.py",
+                "short_description": "MNIST FC net",
+                "author": "tests"}
+    if extra:
+        manifest.update(extra)
+    (pkg / "manifest.json").write_text(json.dumps(manifest))
+    (pkg / "workflow.py").write_text("# the workflow module\n")
+    (pkg / "config.py").write_text("root.mnist.layers = (64, 10)\n")
+    return str(pkg)
+
+
+def test_forge_upload_list_fetch_delete(forge, tmp_path):
+    from veles_tpu.forge import ForgeClient
+
+    client = ForgeClient("127.0.0.1:%d" % forge.port)
+    pkg = _make_package(tmp_path)
+    client.upload(pkg, version="v1")
+    client.upload(pkg, version="v2")
+
+    models = client.list()
+    assert len(models) == 1
+    assert models[0]["name"] == "mnist-fc"
+    assert models[0]["versions"] == ["v1", "v2"]
+
+    details = client.details("mnist-fc")
+    assert details["short_description"] == "MNIST FC net"
+
+    dest = tmp_path / "fetched"
+    _, version = client.fetch("mnist-fc", str(dest))
+    assert version == "v2"  # latest by default
+    assert (dest / "workflow.py").is_file()
+    _, version = client.fetch("mnist-fc", str(dest), version="v1")
+    assert version == "v1"
+
+    client.delete("mnist-fc")
+    assert client.list() == []
+
+
+def test_forge_git_history(forge, tmp_path):
+    from veles_tpu.forge import ForgeClient
+    import shutil
+    import subprocess
+
+    if shutil.which("git") is None:
+        pytest.skip("no git")
+    client = ForgeClient("127.0.0.1:%d" % forge.port)
+    pkg = _make_package(tmp_path)
+    client.upload(pkg, version="v1")
+    client.upload(pkg, version="v2")
+    model_dir = os.path.join(forge.root_dir, "mnist-fc")
+    log = subprocess.run(
+        ["git", "log", "--oneline"], cwd=model_dir,
+        capture_output=True, text=True).stdout
+    assert "version v1" in log and "version v2" in log
+
+
+def test_forge_rejects_bad_packages(forge, tmp_path):
+    from veles_tpu.forge import ForgeClient
+    from veles_tpu.forge.server import validate_package
+    import io
+    import tarfile
+
+    client = ForgeClient("127.0.0.1:%d" % forge.port)
+    # Missing manifest field
+    pkg = tmp_path / "bad"
+    pkg.mkdir()
+    (pkg / "manifest.json").write_text(json.dumps({"name": "bad"}))
+    with pytest.raises(BadFormatError):
+        client.upload(str(pkg))
+    # Zip-slip member
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        blob = b"evil"
+        info = tarfile.TarInfo("../../escape")
+        info.size = len(blob)
+        tar.addfile(info, io.BytesIO(blob))
+    with pytest.raises(BadFormatError):
+        validate_package(buf.getvalue())
+
+
+def test_forge_token_auth(tmp_path):
+    from veles_tpu.forge import ForgeClient, ForgeServer
+    import urllib.error
+
+    server = ForgeServer(str(tmp_path / "reg"), host="127.0.0.1",
+                         port=0, token="sekrit").start()
+    try:
+        pkg = _make_package(tmp_path)
+        bad = ForgeClient("127.0.0.1:%d" % server.port)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            bad.upload(pkg)
+        assert e.value.code == 403
+        good = ForgeClient("127.0.0.1:%d" % server.port,
+                           token="sekrit")
+        good.upload(pkg, version="v1")
+        assert good.list()[0]["name"] == "mnist-fc"
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------- publishing
+
+def test_publisher_renders_all_backends(tmp_path):
+    from veles_tpu.plotting_units import AccumulatingPlotter
+    from veles_tpu.publishing import Publisher
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+    prng.reset()
+    prng.get(0).seed(1234)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=2, learning_rate=0.1)
+    plot = AccumulatingPlotter(wf, name="val err",
+                               input=wf.decision,
+                               input_field="min_validation_err")
+    plot.link_from(wf.decision)
+    pub = Publisher(wf, backends=("markdown", "html", "pdf"),
+                    output_dir=str(tmp_path / "report"))
+    pub.link_from(wf.decision)
+    pub.gate_block = ~wf.decision.complete
+    launcher.initialize()
+    launcher.run()
+    assert len(pub.outputs) == 3
+    md = (tmp_path / "report" / "report.md").read_text()
+    assert "min_validation_err" in md
+    assert "MnistWorkflow" in md
+    assert "val err" in md or "plot_0" in md
+    html_text = (tmp_path / "report" / "report.html").read_text()
+    assert "data:image/png;base64," in html_text
+    assert (tmp_path / "report" / "report.pdf").stat().st_size > 1000
+    assert (tmp_path / "report" / "images" / "plot_0.png").is_file()
+
+
+# ---------------------------------------------------------- interaction
+
+def test_shell_scripted_commands():
+    from veles_tpu.interaction import Shell
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+    prng.reset()
+    prng.get(0).seed(1)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=2, learning_rate=0.1)
+    shell = Shell(wf, once=True, commands=[
+        "workflow.probe_value = len(units)",
+        "workflow.probed_lr = units['gd_fc1'].learning_rate",
+    ])
+    shell.link_from(wf.decision)
+    launcher.initialize()
+    launcher.run()
+    assert wf.probe_value == len(wf.units)
+    assert wf.probed_lr == 0.1
+
+
+# ------------------------------------------------------------- frontend
+
+def test_frontend_generator(tmp_path):
+    from veles_tpu.scripts.generate_frontend import generate
+
+    out = str(tmp_path / "frontend.html")
+    generate(out)
+    page = open(out).read()
+    for flag in ("--result-file", "--optimize", "--ensemble-train",
+                 "--random-seed", "--snapshot"):
+        assert flag in page
+    # unit reference table covers the model layer families
+    for unit in ("All2AllSoftmax", "Conv", "MaxPooling",
+                 "DecisionGD", "EvaluatorSoftmax",
+                 "AudioFileLoader"):
+        assert unit in page
+    assert "compose()" in page  # the live command composer
+
+
+class TestForgeReviewRegressions:
+    def test_gallery_escapes_manifest_html(self, forge, tmp_path):
+        from veles_tpu.forge import ForgeClient
+
+        client = ForgeClient("127.0.0.1:%d" % forge.port)
+        pkg = _make_package(
+            tmp_path, name="xss-model",
+            extra={"short_description":
+                   "<script>alert(1)</script>"})
+        client.upload(pkg, version="v1")
+        page = forge.render_gallery()
+        assert "<script>alert(1)</script>" not in page
+        assert "&lt;script&gt;" in page
+
+    def test_bad_upload_body_is_400(self, forge):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/upload?name=x" % forge.port,
+            data=b"this is not a tarball")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 400
+
+    def test_reupload_keeps_version_order(self, forge, tmp_path):
+        from veles_tpu.forge import ForgeClient
+
+        client = ForgeClient("127.0.0.1:%d" % forge.port)
+        pkg = _make_package(tmp_path)
+        client.upload(pkg, version="v1")
+        client.upload(pkg, version="v2")
+        client.upload(pkg, version="v1")  # hotfix an OLD release
+        dest = tmp_path / "refetch"
+        _, version = client.fetch("mnist-fc", str(dest))
+        assert version == "v2"  # latest is still v2

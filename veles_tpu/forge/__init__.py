@@ -1,0 +1,18 @@
+"""Forge — the model package registry.
+
+Capability parity with the reference forge (reference:
+veles/forge/forge_server.py:103-462 — git-backed model repository
+with upload/fetch/list/details/delete service handlers;
+veles/forge/forge_client.py:91 — the velescli-side client).  See
+:mod:`veles_tpu.forge.server` and :mod:`veles_tpu.forge.client`.
+"""
+
+#: A model package must carry this manifest (reference:
+#: forge_common.py validated the same core fields).  Defined before
+#: the submodule imports — they read these from the partially
+#: initialized package.
+MANIFEST_NAME = "manifest.json"
+REQUIRED_FIELDS = ("name", "workflow", "short_description")
+
+from .server import ForgeServer  # noqa: E402,F401
+from .client import ForgeClient  # noqa: E402,F401

@@ -28,7 +28,12 @@ class NumpyJSONEncoder(json.JSONEncoder):
                 pass
         if isinstance(obj, (set, frozenset)):
             return sorted(obj)
-        return super(NumpyJSONEncoder, self).default(obj)
+        try:
+            return super(NumpyJSONEncoder, self).default(obj)
+        except TypeError:
+            # Config trees carry non-JSON leaves (Tune, callables) —
+            # a readable repr beats failing the whole report/result.
+            return repr(obj)
 
 
 def dump_json(obj, path, **kwargs):

@@ -43,25 +43,65 @@ class ImageLoaderBase(FullBatchLoader):
         self.color_space = kwargs.get("color_space", "RGB")
         self.crop = kwargs.get("crop")
         self.mirror = kwargs.get("mirror", False)
+        # Aspect-preserving scale + padding (reference: image.py's
+        # background/padding handling): when True the image is scaled
+        # to FIT the target and the remainder filled with
+        # ``background_color``; when False it is stretched.
+        self.keep_aspect_ratio = kwargs.get("keep_aspect_ratio",
+                                            False)
+        self.background_color = kwargs.get("background_color", 0)
         ntype = kwargs.get("normalization_type", "none")
         self.normalizer = normalizer_factory(
             ntype, **kwargs.get("normalization_parameters", {}))
 
     # -- preprocessing ------------------------------------------------------
 
+    def _background(self, shape):
+        bg = numpy.asarray(self.background_color,
+                           dtype=numpy.float32)
+        out = numpy.empty(shape, dtype=numpy.float32)
+        out[...] = bg
+        return out
+
     def decode_image(self, path):
         from PIL import Image
         with Image.open(path) as img:
             img = img.convert(self.color_space)
-            img = img.resize(self.size)
-            arr = numpy.asarray(img, dtype=numpy.float32)
+            if self.keep_aspect_ratio:
+                tw, th = self.size
+                scale = min(tw / img.width, th / img.height)
+                nw = max(1, int(round(img.width * scale)))
+                nh = max(1, int(round(img.height * scale)))
+                img = img.resize((nw, nh))
+                arr = numpy.asarray(img, dtype=numpy.float32)
+                if arr.ndim == 2:
+                    arr = arr[:, :, None]
+                canvas = self._background((th, tw, arr.shape[2]))
+                top = (th - nh) // 2
+                left = (tw - nw) // 2
+                canvas[top:top + nh, left:left + nw] = arr
+                arr = canvas
+            else:
+                img = img.resize(self.size)
+                arr = numpy.asarray(img, dtype=numpy.float32)
+                if arr.ndim == 2:
+                    arr = arr[:, :, None]
         if self.crop:
             cw, ch = self.crop
             h, w = arr.shape[:2]
+            if ch > h or cw > w:
+                # Crop larger than the image: pad with background
+                # (reference padding behavior) instead of failing.
+                canvas = self._background((max(ch, h), max(cw, w),
+                                           arr.shape[2]))
+                canvas[(max(ch, h) - h) // 2:
+                       (max(ch, h) - h) // 2 + h,
+                       (max(cw, w) - w) // 2:
+                       (max(cw, w) - w) // 2 + w] = arr
+                arr = canvas
+                h, w = arr.shape[:2]
             top, left = (h - ch) // 2, (w - cw) // 2
             arr = arr[top:top + ch, left:left + cw]
-        if arr.ndim == 2:
-            arr = arr[:, :, None]
         return arr
 
     def _finalize(self, per_class):
@@ -148,3 +188,68 @@ class AutoLabelFileImageLoader(FileImageLoader):
     pass class directories; labels are the subdirectory names."""
 
     MAPPING = "auto_label_file_image"
+
+
+class FileImageMSELoader(FileImageLoader):
+    """Image→image regression datasets (reference: image_mse.py —
+    MSE-target variants): each input image is paired with a TARGET
+    image served through ``minibatch_targets`` for EvaluatorMSE
+    (denoising, super-resolution, autoencoder ground truths).
+
+    kwargs: ``target_paths`` — a directory (targets matched to inputs
+    by filename) or a callable ``path -> target_path``;
+    ``target_size`` — target scale, defaulting to ``size``.
+    """
+
+    MAPPING = "file_image_mse"
+
+    def __init__(self, workflow, **kwargs):
+        super(FileImageMSELoader, self).__init__(workflow, **kwargs)
+        self.target_paths = kwargs.get("target_paths")
+        self.target_size = tuple(kwargs.get("target_size",
+                                            self.size))
+        if self.target_paths is None:
+            raise BadFormatError(
+                "%s requires target_paths (a directory or a "
+                "path->path callable)" % self)
+        if self.mirror:
+            # Fail before any decode work: the target would need
+            # mirroring too, which this loader does not do.
+            raise BadFormatError(
+                "mirror augmentation is not supported with MSE "
+                "targets")
+
+    def target_path_for(self, path):
+        if callable(self.target_paths):
+            return self.target_paths(path)
+        candidate = os.path.join(self.target_paths,
+                                 os.path.basename(path))
+        if not os.path.isfile(candidate):
+            raise BadFormatError("no target image for %s (looked at "
+                                 "%s)" % (path, candidate))
+        return candidate
+
+    def decode_target(self, path):
+        size, self.size = self.size, self.target_size
+        try:
+            return self.decode_image(self.target_path_for(path))
+        finally:
+            self.size = size
+
+    def load_data(self):
+        per_class = {}
+        targets = []
+        for cls in (0, 1, 2):
+            arrs, labs = [], []
+            for path, label in self._expand(self.paths[cls]):
+                arrs.append(self.decode_image(path))
+                targets.append(self.decode_target(path))
+                labs.append(self.get_label_from_path(path)
+                            if label is None else label)
+            per_class[cls] = (arrs, labs)
+        self._finalize(per_class)
+        # Targets ride the SAME normalizer transform as the inputs —
+        # a regression target left at raw scale while inputs are
+        # normalized would silently shift the learning objective.
+        self.original_targets.mem = self.normalizer.normalize(
+            numpy.stack(targets)).astype(numpy.float32)

@@ -42,9 +42,11 @@ class Plotter(Unit):
         raise NotImplementedError()
 
     def run(self):
+        # Data capture always happens (the Publisher reports from
+        # last_data); only live streaming is gated on graphics config.
+        self.last_data = self.plot_data()
         if not config_get(root.common.graphics.enabled, True):
             return
-        self.last_data = self.plot_data()
         server = self.graphics_server
         if server is not None:
             server.publish({

@@ -1,0 +1,1 @@
+"""Utility scripts (reference: veles/scripts/)."""

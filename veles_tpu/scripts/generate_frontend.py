@@ -1,0 +1,160 @@
+"""Frontend generator: an HTML wizard composing a CLI command.
+
+Capability parity with the reference generator (reference:
+veles/scripts/generate_frontend.py — introspects the unit registry +
+aggregated argparse tree and emits the web wizard served by
+``velescli --frontend``, __main__.py:251-325): walks
+:func:`veles_tpu.cmdline.init_argparser`'s actions and the
+:class:`~veles_tpu.registry.UnitRegistry` catalogue, and writes a
+self-contained ``frontend.html`` — form fields per option, a unit
+reference table, and live command-line composition in JavaScript.
+
+Run: ``python -m veles_tpu.scripts.generate_frontend [-o FILE]``.
+"""
+
+import argparse
+import html
+import json
+
+
+def collect_options():
+    """[(flag, help, choices, default, is_positional)] from the
+    aggregated parser."""
+    from ..cmdline import init_argparser
+    parser = init_argparser(prog="veles_tpu")
+    options = []
+    for action in parser._actions:
+        if isinstance(action, argparse._HelpAction):
+            continue
+        flag = (max(action.option_strings, key=len)
+                if action.option_strings else action.dest)
+        options.append({
+            "flag": flag,
+            "positional": not action.option_strings,
+            "help": action.help or "",
+            "choices": list(action.choices) if action.choices
+            else None,
+            "default": action.default
+            if action.default not in (None, "") else None,
+            "is_bool": isinstance(
+                action, (argparse._StoreTrueAction,
+                         argparse._StoreFalseAction)),
+        })
+    return options
+
+
+def collect_units():
+    """[(class name, doc first line, view group)] from the unit
+    registry — import the model/loader packages first so the
+    catalogue is complete."""
+    from .. import plotting_units, snapshotter  # noqa: F401
+    from ..loader import audio, fullbatch, image  # noqa: F401
+    from ..znicz import (all2all, conv, decision, dropout,  # noqa
+                         evaluator, kohonen, lrn, pooling, rbm)
+    from ..registry import UnitRegistry
+    units = []
+    for cls in sorted(UnitRegistry.units, key=lambda c: c.__name__):
+        doc = (cls.__doc__ or "").strip().splitlines()
+        units.append({
+            "name": cls.__name__,
+            "module": cls.__module__,
+            "doc": doc[0] if doc else "",
+            "mapping": getattr(cls, "MAPPING", None),
+        })
+    return units
+
+
+_TEMPLATE = """<!DOCTYPE html>
+<html><head><title>veles_tpu launcher wizard</title>
+<style>
+body {{ font-family: sans-serif; margin: 2em; max-width: 70em; }}
+fieldset {{ margin-bottom: 1em; }}
+label {{ display: inline-block; min-width: 16em; }}
+#cmd {{ background: #222; color: #9e9; padding: 1em;
+       font-family: monospace; white-space: pre-wrap; }}
+table {{ border-collapse: collapse; font-size: 90%; }}
+td, th {{ border: 1px solid #aaa; padding: 3px 8px; }}
+</style></head><body>
+<h1>veles_tpu launcher wizard</h1>
+<p>Fill the fields; the command line composes itself below
+(reference capability: the velescli web frontend).</p>
+<form id="form" oninput="compose()">{fields}</form>
+<h2>Command</h2><div id="cmd">python -m veles_tpu</div>
+<h2>Unit reference</h2>
+<table><tr><th>unit</th><th>mapping</th><th>module</th>
+<th>summary</th></tr>{units}</table>
+<script>
+const OPTIONS = {options_json};
+function compose() {{
+  let parts = ["python -m veles_tpu"];
+  for (const opt of OPTIONS) {{
+    const el = document.getElementById(opt.flag);
+    if (!el) continue;
+    if (opt.is_bool) {{
+      if (el.checked) parts.push(opt.flag);
+    }} else if (el.value) {{
+      if (opt.positional) parts.push(el.value);
+      else parts.push(opt.flag + " " + el.value);
+    }}
+  }}
+  document.getElementById("cmd").textContent = parts.join(" ");
+}}
+compose();
+</script></body></html>
+"""
+
+
+def _field(opt):
+    flag = html.escape(opt["flag"])
+    label = "<label for='%s'>%s</label>" % (flag, flag)
+    title = html.escape(opt["help"])
+    if opt["is_bool"]:
+        control = ("<input type='checkbox' id='%s' title='%s'/>"
+                   % (flag, title))
+    elif opt["choices"]:
+        opts = "".join(
+            "<option%s>%s</option>" %
+            (" selected" if c == opt["default"] else "",
+             html.escape(str(c)))
+            for c in [""] + list(opt["choices"]))
+        control = ("<select id='%s' title='%s'>%s</select>"
+                   % (flag, title, opts))
+    else:
+        value = html.escape(str(opt["default"])) \
+            if opt["default"] is not None else ""
+        control = ("<input id='%s' title='%s' value='%s' "
+                   "size='40'/>" % (flag, title, value))
+    return ("<div>%s %s <small>%s</small></div>"
+            % (label, control, title))
+
+
+def generate(output="frontend.html"):
+    options = collect_options()
+    units = collect_units()
+    fields = "\n".join(_field(o) for o in options)
+    unit_rows = "\n".join(
+        "<tr><td>%s</td><td>%s</td><td>%s</td><td>%s</td></tr>" %
+        (html.escape(u["name"]),
+         html.escape(str(u["mapping"] or "")),
+         html.escape(u["module"]), html.escape(u["doc"]))
+        for u in units)
+    page = _TEMPLATE.format(fields=fields, units=unit_rows,
+                            options_json=json.dumps(options))
+    with open(output, "w") as fout:
+        fout.write(page)
+    return output
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu.scripts.generate_frontend")
+    parser.add_argument("-o", "--output", default="frontend.html")
+    args = parser.parse_args(argv)
+    path = generate(args.output)
+    print("frontend -> %s" % path)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())
