@@ -190,3 +190,58 @@ def test_deconv_inverts_geometry():
     deconv.output.map_read()
     assert deconv.output.mem.shape == (2, 8, 8, 3)
     assert numpy.abs(deconv.output.mem).max() > 0
+
+
+def test_space_to_depth_matches_plain_conv():
+    """The folded stride-f form must be bit-equivalent conv math
+    (AlexNet conv1's MXU layout lever)."""
+    prng.get(0).seed(5)
+    rng = numpy.random.RandomState(9)
+    x = rng.rand(2, 227, 227, 3).astype(numpy.float32)
+    plain = _unit_with_input(Conv, x, n_kernels=8, kx=11, ky=11,
+                             sliding=(4, 4))
+    plain.eager_run()
+    folded = _unit_with_input(Conv, x, n_kernels=8, kx=11, ky=11,
+                              sliding=(4, 4), space_to_depth=4)
+    folded.weights.map_write()
+    plain.weights.map_read()
+    folded.weights.mem[...] = plain.weights.mem
+    folded.bias.map_write()
+    plain.bias.map_read()
+    folded.bias.mem[...] = plain.bias.mem
+    folded.eager_run()
+    plain.output.map_read()
+    folded.output.map_read()
+    assert folded.output.shape == plain.output.shape == (2, 55, 55, 8)
+    numpy.testing.assert_allclose(folded.output.mem,
+                                  plain.output.mem,
+                                  rtol=2e-2, atol=2e-2)
+
+
+def test_space_to_depth_with_padding():
+    prng.get(0).seed(5)
+    rng = numpy.random.RandomState(10)
+    x = rng.rand(2, 16, 16, 3).astype(numpy.float32)
+    plain = _unit_with_input(Conv, x, n_kernels=4, kx=4, ky=4,
+                             padding=2, sliding=(2, 2))
+    plain.eager_run()
+    folded = _unit_with_input(Conv, x, n_kernels=4, kx=4, ky=4,
+                              padding=2, sliding=(2, 2),
+                              space_to_depth=2)
+    for attr in ("weights", "bias"):
+        getattr(folded, attr).map_write()
+        getattr(plain, attr).map_read()
+        getattr(folded, attr).mem[...] = getattr(plain, attr).mem
+    folded.eager_run()
+    plain.output.map_read()
+    folded.output.map_read()
+    numpy.testing.assert_allclose(folded.output.mem,
+                                  plain.output.mem,
+                                  rtol=2e-2, atol=2e-2)
+
+
+def test_space_to_depth_stride_mismatch_rejected():
+    with pytest.raises(ValueError):
+        _unit_with_input(Conv, numpy.zeros((1, 8, 8, 3)),
+                         n_kernels=2, kx=3, ky=3, sliding=(2, 2),
+                         space_to_depth=4)

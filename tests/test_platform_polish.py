@@ -1,0 +1,104 @@
+"""Platform polish tests: per-subsystem CLI flags, DB snapshotter,
+remote worker spawn via --nodes (reference capabilities:
+cmdline per-class aggregation, snapshotter.py:425 SnapshotterToDB,
+launcher.py:809-843 node spawn)."""
+
+import json
+import os
+
+import pytest
+
+import veles_tpu.prng as prng
+from veles_tpu.config import root
+from veles_tpu.launcher import Launcher
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MNIST = os.path.join(REPO, "veles_tpu", "znicz", "samples", "mnist.py")
+
+
+def test_subsystem_flags_in_help():
+    from veles_tpu.cmdline import init_argparser
+    text = init_argparser(prog="veles_tpu").format_help()
+    for flag in ("--async-slave", "--slave-death-probability",
+                 "--measure-power", "--train-ratio",
+                 "--shuffle-limit", "--snapshot-dir",
+                 "--no-snapshots", "--nodes"):
+        assert flag in text
+
+
+def test_train_ratio_flag_feeds_config(tmp_path):
+    from veles_tpu.__main__ import Main
+
+    result = tmp_path / "r.json"
+    prng.reset()
+    rc = Main([MNIST, "root.mnist.max_epochs=1",
+               "--train-ratio", "0.5",
+               "--result-file", str(result),
+               "-v", "warning"]).run()
+    assert rc == 0
+    assert root.common.loader.get("train_ratio") == 0.5
+    root.common.loader.train_ratio = 1.0
+    root.mnist.reset()
+
+
+def test_db_snapshotter_roundtrip(tmp_path):
+    from veles_tpu.snapshotter import SnapshotterToDB
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+    prng.reset()
+    prng.get(0).seed(5)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=2, learning_rate=0.1)
+    snap = SnapshotterToDB(
+        wf, database=str(tmp_path / "snaps.sqlite"),
+        prefix="mnist", time_interval=0.0)
+    snap.link_from(wf.decision)
+    launcher.initialize()
+    launcher.run()
+    assert snap.destination
+
+    wf2 = SnapshotterToDB.import_(
+        "odbc://" + str(tmp_path / "snaps.sqlite"), prefix="mnist")
+    assert type(wf2).__name__ == "MnistWorkflow"
+    l2 = Launcher()
+    l2.add_ref(wf2)
+    wf2.decision.max_epochs = 3
+    l2.initialize()
+    l2._finished.clear()
+    wf2.run()
+    assert wf2.gather_results()["epochs"] == 3
+
+
+def test_db_snapshotter_missing_rows(tmp_path):
+    import sqlite3
+    from veles_tpu.snapshotter import SnapshotterToDB
+
+    db = str(tmp_path / "empty.sqlite")
+    with sqlite3.connect(db) as conn:
+        conn.execute(SnapshotterToDB.TABLE_DDL)
+    with pytest.raises(FileNotFoundError):
+        SnapshotterToDB.import_(db)
+
+
+def test_nodes_local_spawns_worker_end_to_end(tmp_path):
+    """`-l :0 --nodes local` spawns a subprocess worker that joins
+    and trains to completion (reference: launcher node spawn +
+    server-driven training)."""
+    from veles_tpu.__main__ import Main
+
+    result = tmp_path / "dist.json"
+    prng.reset()
+    m = Main([MNIST, "root.mnist.max_epochs=3",
+              "root.mnist.learning_rate=0.05",
+              "-l", "127.0.0.1:0", "--nodes", "local",
+              "--result-file", str(result),
+              "--random-seed", "77", "-v", "warning"])
+    rc = m.run()
+    assert rc == 0
+    data = json.loads(result.read_text())
+    assert data["mode"] == "master"
+    assert data["results"]["epochs"] == 3
+    assert data["results"]["min_validation_err"] < 0.5
+    # the spawned worker process was tracked and reaped
+    assert len(m.launcher._worker_procs) >= 1
+    root.mnist.reset()

@@ -135,13 +135,75 @@ class Main(Logger, CommandLineBase):
 
     # -- workflow construction (the load/main closures) --------------------
 
+    def _filtered_worker_argv(self):
+        """The velescli argv spawned workers run — RECONSTRUCTED from
+        the parsed args rather than filtered from raw argv (raw-string
+        filtering misses argparse abbreviations like --listen, which
+        would turn workers into recursive coordinators).  Reference
+        analogue: launcher.py:75 argv filtering."""
+        a = self.args
+        out = [a.workflow] + list(a.config)
+        for path in a.config_list:
+            out += ["-c", path]
+        if a.random_seed:
+            out += ["--random-seed", a.random_seed]
+        if a.verbosity != "info":
+            out += ["-v", a.verbosity]
+        if a.backend:
+            out += ["-a", a.backend]
+        if a.max_epochs:
+            out += ["--max-epochs", str(a.max_epochs)]
+        if a.async_slave:
+            out.append("--async-slave")
+        if a.slave_death_probability:
+            out += ["--slave-death-probability",
+                    str(a.slave_death_probability)]
+        if a.measure_power:
+            out.append("--measure-power")
+        if a.train_ratio is not None:
+            out += ["--train-ratio", str(a.train_ratio)]
+        if a.shuffle_limit is not None:
+            out += ["--shuffle-limit", str(a.shuffle_limit)]
+        return out + ["-m", "{master}"]
+
     def _launcher_kwargs(self):
         kw = {}
         if self.args.listen_address:
             kw["listen_address"] = self.args.listen_address
+            if self.args.nodes:
+                kw["nodes"] = [n.strip() for n in
+                               self.args.nodes.split(",") if n.strip()]
+                kw["worker_argv"] = self._filtered_worker_argv()
         if self.args.master_address:
             kw["master_address"] = self.args.master_address
+            slave_kwargs = {}
+            if self.args.async_slave:
+                slave_kwargs["async_mode"] = True
+            if self.args.slave_death_probability:
+                slave_kwargs["death_probability"] = \
+                    self.args.slave_death_probability
+            if self.args.measure_power:
+                slave_kwargs["measure_power"] = True
+            if slave_kwargs:
+                kw["slave_kwargs"] = slave_kwargs
         return kw
+
+    def apply_subsystem_flags(self):
+        """Feeds aggregated per-subsystem flags into the config tree
+        (the reference's per-class flags were read by each class
+        directly; here config is the handoff point)."""
+        args = self.args
+        if args.train_ratio is not None:
+            root.common.loader.train_ratio = args.train_ratio
+        if args.shuffle_limit is not None:
+            root.common.loader.shuffle_limit = args.shuffle_limit
+        if args.snapshot_dir is not None:
+            root.common.dirs.snapshots = args.snapshot_dir
+        if args.snapshot_compression is not None:
+            root.common.snapshotter.compression = \
+                args.snapshot_compression
+        if args.no_snapshots:
+            root.common.snapshot_disabled = True
 
     def load(self, WorkflowClass, **kwargs):
         """``load`` closure passed to the module's run() hook
@@ -150,7 +212,12 @@ class Main(Logger, CommandLineBase):
         kwargs.setdefault("result_file", self.args.result_file or None)
         self.launcher = Launcher(**self._launcher_kwargs())
         if self.args.snapshot:
-            self.workflow = SnapshotterToFile.import_(self.args.snapshot)
+            spec = self.args.snapshot
+            if spec.startswith(("odbc://", "sqlite://", "db://")):
+                from .snapshotter import SnapshotterToDB
+                self.workflow = SnapshotterToDB.import_(spec)
+            else:
+                self.workflow = SnapshotterToFile.import_(spec)
             self._snapshot_loaded = True
             self.launcher.add_ref(self.workflow)
             self.info("resumed snapshot %s (%s)", self.args.snapshot,
@@ -264,6 +331,7 @@ class Main(Logger, CommandLineBase):
             return self.EXIT_FAILURE
         try:
             self.seed_random()
+            self.apply_subsystem_flags()
             apply_config_sources(
                 list(self.args.config) + list(self.args.config_list),
                 logger=self)

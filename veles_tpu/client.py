@@ -17,6 +17,23 @@ from .network_common import (Channel, connect, machine_id,
                              normalize_secret)
 
 
+def init_parser(parser):
+    """Worker-side flags, aggregated into the velescli parser
+    (reference: client.py's --async-slave / fault-injection flags)."""
+    parser.add_argument(
+        "--async-slave", action="store_true",
+        help="pipeline worker jobs: request job N+1 before sending "
+             "update N (higher utilization, staler gradients)")
+    parser.add_argument(
+        "--slave-death-probability", type=float, default=0.0,
+        metavar="P", help="chaos testing: worker kills itself with "
+                          "probability P per job")
+    parser.add_argument(
+        "--measure-power", action="store_true",
+        help="benchmark this worker's computing power and report it "
+             "to the coordinator (periodic re-measure included)")
+
+
 def measure_computing_power(repeats=2, n=1024):
     """GEMM-throughput scalar used for load balancing (reference:
     accelerated_units.py:699-817 ``DeviceBenchmark`` — 1000/dt of a

@@ -80,6 +80,11 @@ class CommandLineBase(object):
             help="run as a worker (slave) of the coordinator at "
                  "HOST:PORT")
         parser.add_argument(
+            "--nodes", default="", metavar="HOST[,HOST...]",
+            help="with -l: spawn workers on these hosts over ssh "
+                 "('local' spawns subprocesses on this machine); "
+                 "dropped workers respawn the same way")
+        parser.add_argument(
             "-r", "--random-seed", default="", metavar="SPEC",
             help="seed spec: an integer, or file:count:dtype "
                  "(e.g. /dev/urandom:16:uint32)")
@@ -127,16 +132,37 @@ class CommandLineBase(object):
         return parser
 
 
+#: Modules that contribute flags via a module-level
+#: ``init_parser(parser)`` — imported on demand so subsystems stay
+#: lazily loadable yet their flags always appear (the reference's
+#: per-class aggregation relied on import side effects instead,
+#: cmdline.py:61).
+CONTRIBUTING_MODULES = (
+    "veles_tpu.client",
+    "veles_tpu.loader.base",
+    "veles_tpu.snapshotter",
+)
+
+
 def init_argparser(**kwargs):
     """Builds the aggregated parser: base options + every registered
-    class's ``init_parser`` (reference: cmdline.py's per-class argparse
-    merge)."""
+    class's ``init_parser`` + the contributing modules' hooks
+    (reference: cmdline.py's per-class argparse merge)."""
+    import importlib
     kwargs.setdefault("formatter_class", SortedHelpFormatter)
     kwargs.setdefault(
         "description",
         "veles_tpu — TPU-native distributed dataflow ML platform")
     parser = argparse.ArgumentParser(**kwargs)
     CommandLineBase.init_parser(parser)
+    for name in CONTRIBUTING_MODULES:
+        module = importlib.import_module(name)
+        hook = getattr(module, "init_parser", None)
+        if hook is not None:
+            try:
+                hook(parser)
+            except argparse.ArgumentError:
+                pass
     seen = {CommandLineBase}
     for cls in CommandLineArgumentsRegistry.classes:
         if cls in seen:

@@ -63,6 +63,15 @@ class Launcher(Logger):
         self._heartbeat_thread = None
         self._heartbeat_stop = threading.Event()
         self.graphics_server = None
+        # Remote worker spawn (reference: launcher.py:809-843
+        # paramiko/SSH _launch_nodes): ``nodes`` lists worker hosts —
+        # "local" spawns a subprocess on this machine, anything else
+        # goes through ssh; ``worker_argv`` is the velescli argv the
+        # workers run (Main filters its own coordinator flags out,
+        # {master} is substituted with our address).
+        self.nodes = list(kwargs.get("nodes") or [])
+        self.worker_argv = list(kwargs.get("worker_argv") or [])
+        self._worker_procs = []
 
     # -- mode flags (reference API) ----------------------------------------
 
@@ -129,7 +138,67 @@ class Launcher(Logger):
             self.graphics_server = GraphicsServer.launch()
         if self.status_address and not self.is_slave:
             self._start_heartbeats()
+        if self.nodes and self.server is not None:
+            self.launch_remote_workers()
+            # Dropped workers respawn through the same spawner
+            # (reference: server.py:637-655 SSH respawn).
+            self.server.respawn = lambda desc: \
+                self._spawn_worker(self._node_of(desc))
         return self
+
+    # -- remote worker spawn (reference: launcher.py:809-843) --------------
+
+    def _master_endpoint(self):
+        import socket as socket_mod
+        host, _ = self.listen_address.rsplit(":", 1) \
+            if ":" in self.listen_address else (self.listen_address,
+                                                "")
+        if host in ("", "0.0.0.0", "::"):
+            host = socket_mod.getfqdn()
+        return "%s:%d" % (host, self.server.port)
+
+    def _worker_command(self, master):
+        import sys
+        argv = [arg.replace("{master}", master)
+                for arg in self.worker_argv]
+        if "-m" not in argv and "--master-address" not in argv:
+            argv += ["-m", master]
+        return [sys.executable, "-m", "veles_tpu"] + argv
+
+    def _spawn_worker(self, node):
+        import os as os_mod
+        import subprocess
+        master = self._master_endpoint()
+        cmd = self._worker_command(
+            "127.0.0.1:%d" % self.server.port
+            if node in ("local", "localhost") else master)
+        if node not in ("local", "localhost"):
+            # ssh host 'cd <cwd> && exec python -m veles_tpu ...'
+            import shlex
+            remote = "cd %s && exec %s" % (
+                shlex.quote(os_mod.getcwd()),
+                " ".join(shlex.quote(a) for a in cmd))
+            cmd = ["ssh", "-o", "BatchMode=yes", node, remote]
+        self.info("spawning worker on %s: %s", node, " ".join(cmd))
+        proc = subprocess.Popen(cmd)
+        self._worker_procs.append((node, proc))
+        return proc
+
+    def launch_remote_workers(self):
+        for node in self.nodes:
+            self._spawn_worker(node)
+
+    def _node_of(self, desc):
+        """Node for a dropped worker's respawn: the one with the
+        fewest live worker processes — a died worker's ssh/subprocess
+        has exited, so its node shows the capacity gap."""
+        if not self.nodes:
+            return "local"
+        alive = {node: 0 for node in self.nodes}
+        for node, proc in self._worker_procs:
+            if proc.poll() is None and node in alive:
+                alive[node] += 1
+        return min(self.nodes, key=lambda n: alive[n])
 
     def run(self):
         """Runs the workflow to completion (blocking)
@@ -237,6 +306,9 @@ class Launcher(Logger):
 
     def stop(self):
         self._heartbeat_stop.set()
+        for node, proc in self._worker_procs:
+            if proc.poll() is None:
+                proc.terminate()
         if self.server is not None:
             self.server.stop()
         if self.client is not None:

@@ -39,6 +39,18 @@ TEST, VALID, TRAIN = 0, 1, 2
 CLASS_NAME = ("test", "validation", "train")
 
 
+def init_parser(parser):
+    """Loader flags for the aggregated velescli parser (reference:
+    --train-ratio, loader/base.py)."""
+    parser.add_argument(
+        "--train-ratio", type=float, default=None, metavar="R",
+        help="train on a random R-fraction of the train set "
+             "(sets root.common.loader.train_ratio)")
+    parser.add_argument(
+        "--shuffle-limit", type=int, default=None, metavar="N",
+        help="stop reshuffling train indices after epoch N")
+
+
 class UserLoaderRegistry(MappedUnitRegistry):
     """String → loader class factory (reference: base.py:83-93)."""
     registry = {}
@@ -70,11 +82,15 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self.class_lengths = [0, 0, 0]
         self.epoch_number = 0
         self.prng_key = kwargs.get("prng_key", 0)
-        self.shuffle_limit = kwargs.get("shuffle_limit", numpy.inf)
+        from ..config import root as _root
+        self.shuffle_limit = kwargs.get(
+            "shuffle_limit",
+            _root.common.loader.get("shuffle_limit", numpy.inf))
+        if self.shuffle_limit in (-1, None):
+            self.shuffle_limit = numpy.inf
         # Per-run config default lets the ensemble trainer vary the
         # train subset without touching workflow constructors
         # (reference: --train-ratio flag, loader/base.py).
-        from ..config import root as _root
         self.train_ratio = kwargs.get(
             "train_ratio", _root.common.loader.get("train_ratio", 1.0))
         super(Loader, self).__init__(workflow, **kwargs)

@@ -27,6 +27,22 @@ from .config import root, get as config_get
 from .registry import MappedUnitRegistry
 from .units import Unit
 
+def init_parser(parser):
+    """Snapshotter flags for the aggregated velescli parser."""
+    parser.add_argument(
+        "--snapshot-dir", default=None, metavar="DIR",
+        help="snapshot destination directory "
+             "(sets root.common.dirs.snapshots)")
+    parser.add_argument(
+        "--snapshot-compression", default=None,
+        choices=("", "gz", "bz2", "xz"),
+        help="snapshot codec (sets root.common.snapshotter."
+             "compression)")
+    parser.add_argument(
+        "--no-snapshots", action="store_true",
+        help="disable snapshotting for this run")
+
+
 CODECS = {
     "": (lambda p: open(p, "wb"), lambda p: open(p, "rb"), ""),
     "gz": (lambda p: gzip.open(p, "wb"),
@@ -59,9 +75,14 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
 
     def __init__(self, workflow, **kwargs):
         self.prefix = kwargs.get("prefix", "snapshot")
-        self.compression = kwargs.get("compression", "gz")
-        self.interval = kwargs.get("interval", 1)
-        self.time_interval = kwargs.get("time_interval", 1.0)
+        self.compression = kwargs.get(
+            "compression",
+            root.common.snapshotter.get("compression", "gz"))
+        self.interval = kwargs.get(
+            "interval", root.common.snapshotter.get("interval", 1))
+        self.time_interval = kwargs.get(
+            "time_interval",
+            root.common.snapshotter.get("time_interval", 1.0))
         self.skip = kwargs.get("skip", False)
         super(SnapshotterBase, self).__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
@@ -142,3 +163,89 @@ class SnapshotterToFile(SnapshotterBase):
                     return pickle.load(fin)
         with open(path, "rb") as fin:
             return pickle.load(fin)
+
+
+class SnapshotterToDB(SnapshotterBase):
+    """Database snapshot backend (reference: snapshotter.py:425
+    ``SnapshotterToDB`` over pyodbc; here stdlib sqlite3 — same
+    capability, no driver dependency.  ``database`` accepts a file
+    path or an ``odbc://``-style spec whose tail is treated as the
+    file path).
+
+    Snapshots land in a ``snapshots`` table (prefix, suffix, created,
+    codec, blob); resume with
+    ``SnapshotterToDB.import_(database, prefix=...)`` which loads the
+    newest matching row — the reference's ``-s odbc://...`` flow.
+    """
+
+    MAPPING = "db"
+
+    TABLE_DDL = ("CREATE TABLE IF NOT EXISTS snapshots ("
+                 "id INTEGER PRIMARY KEY AUTOINCREMENT, "
+                 "prefix TEXT NOT NULL, suffix TEXT, "
+                 "created REAL NOT NULL, codec TEXT, "
+                 "blob BLOB NOT NULL)")
+
+    def __init__(self, workflow, **kwargs):
+        super(SnapshotterToDB, self).__init__(workflow, **kwargs)
+        self.database = self._db_path(kwargs["database"])
+
+    @staticmethod
+    def _db_path(spec):
+        for scheme in ("odbc://", "sqlite://", "db://"):
+            if spec.startswith(scheme):
+                return spec[len(scheme):]
+        return spec
+
+    def export(self):
+        import sqlite3
+        blob = pickle.dumps(self.workflow,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        if self.compression == "gz":
+            blob = gzip.compress(blob)
+        elif self.compression == "bz2":
+            blob = bz2.compress(blob)
+        elif self.compression == "xz":
+            blob = lzma.compress(blob)
+        os.makedirs(os.path.dirname(os.path.abspath(self.database)),
+                    exist_ok=True)
+        with sqlite3.connect(self.database) as conn:
+            conn.execute(self.TABLE_DDL)
+            conn.execute(
+                "INSERT INTO snapshots (prefix, suffix, created, "
+                "codec, blob) VALUES (?, ?, ?, ?, ?)",
+                (self.prefix, self.suffix, time.time(),
+                 self.compression, sqlite3.Binary(blob)))
+        self.destination = "%s#%s" % (self.database, self.prefix)
+        self.info("snapshot -> %s (%.1f MB)", self.destination,
+                  len(blob) / 1e6)
+
+    @staticmethod
+    def import_(database, prefix=None):
+        """Loads the newest snapshot (optionally filtered by prefix)
+        from the database."""
+        import sqlite3
+        path = SnapshotterToDB._db_path(database)
+        with sqlite3.connect(path) as conn:
+            if prefix is None:
+                row = conn.execute(
+                    "SELECT codec, blob FROM snapshots "
+                    "ORDER BY id DESC LIMIT 1").fetchone()
+            else:
+                row = conn.execute(
+                    "SELECT codec, blob FROM snapshots WHERE "
+                    "prefix = ? ORDER BY id DESC LIMIT 1",
+                    (prefix,)).fetchone()
+        if row is None:
+            raise FileNotFoundError(
+                "no snapshot rows in %s (prefix=%r)"
+                % (path, prefix))
+        codec, blob = row
+        blob = bytes(blob)
+        if codec == "gz":
+            blob = gzip.decompress(blob)
+        elif codec == "bz2":
+            blob = bz2.decompress(blob)
+        elif codec == "xz":
+            blob = lzma.decompress(blob)
+        return pickle.loads(blob)

@@ -56,14 +56,17 @@ class LRNormalizerForward(ForwardBase):
         d = i[:, None] - i[None, :]  # input minus output channel
         band = ((d >= -half) &
                 (d <= self.n - 1 - half)).astype(jnp.float32)
-        # Squaring happens after an exact upcast to f32 (bf16→f32 is
-        # lossless, while a bf16 multiply would round every square);
-        # the banded matmul itself runs at DEFAULT precision — the
-        # MXU's bf16 passes round sq to 8 mantissa bits, which is
-        # ample for a 5-term window sum entering k + α/n·Σ — and the
-        # output returns to the input dtype so the activation stream
-        # stays narrow.
-        x32 = x.astype(jnp.float32)
-        ssum = jnp.einsum("...c,cd->...d", x32 * x32, band)
+        # The squares stay in the activation dtype: the banded matmul
+        # rounds its operands to bf16 on the MXU anyway, so an f32
+        # square would buy 0 extra bits in the sum while DOUBLING the
+        # HBM traffic of the largest intermediate in the net (the
+        # conv1 activation square) — this op is bandwidth-bound, not
+        # FLOP-bound.  Accumulation is f32 via preferred_element_type,
+        # the denominator math runs in f32.
+        sq = x * x
+        ssum = jnp.einsum("...c,cd->...d", sq,
+                          band.astype(x.dtype),
+                          preferred_element_type=jnp.float32)
         denom = (self.k + (self.alpha / self.n) * ssum) ** self.beta
-        write(self.output, (x32 / denom).astype(x.dtype))
+        write(self.output,
+              (x.astype(jnp.float32) / denom).astype(x.dtype))

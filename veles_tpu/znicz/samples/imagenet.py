@@ -132,6 +132,11 @@ def alexnet_layers(n_classes=1000, lr=0.01, moment=0.9, decay=5e-4):
         {"type": "conv_str",
          "->": {"n_kernels": 96, "kx": 11, "ky": 11,
                 "sliding": (4, 4), "weights_stddev": 0.01,
+                # space_to_depth=4 is available (conv.py) but
+                # measured NEUTRAL-to-slower inside the fused step on
+                # v5e — XLA's own conv lowering already handles the
+                # C=3 stride-4 case well, and the fold's transposes
+                # cost more than the MXU win.  Left off.
                 "bias_stddev": 0}, "<-": dict(gd)},
         {"type": "norm", "->": {"alpha": 1e-4, "beta": 0.75, "n": 5,
                                 "k": 2.0}},
