@@ -128,3 +128,18 @@ def test_failed_minibatch_keeps_class():
     loader.serve_next_minibatch()          # the retry
     assert loader.minibatch_class == VALID
     assert not loader.last_minibatch
+
+
+def test_in_flight_record_tracks_serves():
+    """Single serves record one minibatch; block serves record the
+    whole block (elastic recovery requeues exactly these)."""
+    loader = make_loader(minibatch_size=8)
+    loader.serve_next_minibatch()
+    assert len(loader._in_flight_) == 1
+    idx, cls = loader._in_flight_[0]
+    assert len(idx) == 8
+    blocks = loader.serve_block(3)
+    assert len(loader._in_flight_) == \
+        next(iter(blocks.values())).shape[0]
+    for idx, cls in loader._in_flight_:
+        assert 1 <= len(idx) <= 8

@@ -117,3 +117,39 @@ def test_pickle_resume_continues_training():
     wf2.run()
     r = wf2.gather_results()
     assert r["epochs"] == 4
+
+
+def test_elastic_mesh_rebuild_on_chip_loss():
+    """Mid-training mesh shrink 8 → 4 devices: training state
+    survives (replicated params), the interrupted minibatch is
+    requeued, and convergence continues on the smaller mesh
+    (SPMD equivalent of drop_slave+requeue, parallel/mesh.py)."""
+    import jax
+    from veles_tpu.parallel import (apply_dp_sharding, make_mesh,
+                                    rebuild_mesh)
+    prng.reset()
+    prng.get(0).seed(1234)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, minibatch_size=96, max_epochs=2,
+                       learning_rate=0.1)
+    launcher.initialize()
+    mesh = make_mesh(jax.devices(), {"data": 8})
+    apply_dp_sharding(wf, mesh)
+    launcher._finished.clear()
+    wf.run()
+    mid = wf.gather_results()["min_validation_err"]
+
+    # "Lose" 4 chips: rebuild over the survivors and keep training.
+    survivors = jax.devices()[:4]
+    rebuild_mesh(wf, survivors)
+    assert len(wf.loader.failed_minibatches) == 1
+    wf.decision.max_epochs = 5
+    wf.decision.complete <<= False
+    wf._finished_.clear()
+    wf.run()
+    results = wf.gather_results()
+    assert results["epochs"] == 5
+    assert results["min_validation_err"] <= mid + 1e-9
+    assert results["min_validation_err"] < 0.12
+    some_param = next(iter(wf.compiler._param_vecs.values()))
+    assert len(some_param.devmem.sharding.device_set) == 4

@@ -114,6 +114,11 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
     def init_unpickled(self):
         super(Loader, self).init_unpickled()
         self._pending_indices_ = {}
+        # Minibatches served but possibly not yet committed by the
+        # step — elastic recovery (parallel.rebuild_mesh) requeues
+        # them.  Single-tick serves hold one entry; a block serve
+        # holds the whole block.
+        self._in_flight_ = []
 
     # -- derived sizes -----------------------------------------------------
 
@@ -224,6 +229,9 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self.minibatch_class_vec.mem = numpy.array(
             self.minibatch_class, dtype=numpy.int32)
         self.minibatch_size = count
+        self._in_flight_ = [(numpy.array(indices,
+                                         dtype=numpy.int32),
+                             self.minibatch_class)]
         return indices
 
     def _next_fresh_indices(self):
@@ -286,6 +294,12 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         served = len(idxs)
         cls_arr = numpy.full(served, self.minibatch_class,
                              dtype=numpy.int32)
+        # The WHOLE block is in flight until its one dispatch commits
+        # (per-tick serves above each overwrote the record).
+        self._in_flight_ = [
+            (idx[:int(mask.sum())].astype(numpy.int32),
+             int(c))
+            for idx, mask, c in zip(idxs, masks, cls_arr)]
         return {
             str(id(self.minibatch_indices)): numpy.stack(idxs),
             str(id(self.minibatch_mask)): numpy.stack(masks),

@@ -1,1 +1,2 @@
-from .mesh import make_mesh, apply_dp_sharding  # noqa: F401
+from .mesh import (make_mesh, apply_dp_sharding,  # noqa: F401
+                   rebuild_mesh)

@@ -20,8 +20,10 @@ TPU-native formulation:
 
 Elasticity note: the reference drops slaves and requeues their
 minibatches (server.py:315-338).  SPMD equivalents operate at mesh
-granularity: on chip loss the launcher rebuilds the mesh and the loader
-requeues in-flight indices (the failed-minibatch queue survives as-is).
+granularity: on chip loss :func:`rebuild_mesh` re-forms the mesh over
+the survivors, re-places every step tensor, requeues the interrupted
+minibatch (the failed-minibatch queue survives as-is), and the next
+tick compiles for the new topology.
 """
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -75,6 +77,53 @@ def apply_dp_sharding(workflow, mesh, axis="data"):
         vec.sharding = replicated
     for vec in compiler.const_vectors:
         vec.sharding = replicated
-    # Activations derive shardings from inputs; persisted outputs too.
+    # Persisted step outputs are batch-shaped: shard them like batch
+    # vectors so host reads after a rebuild never touch buffers on a
+    # departed device set.
+    for vec in compiler.persist_vectors:
+        shape = vec.shape
+        if shape and len(shape) >= 1 and shape[0] % n == 0:
+            vec.sharding = sharded
+        else:
+            vec.sharding = replicated
     workflow.mesh = mesh
     return workflow
+
+
+def rebuild_mesh(workflow, surviving_devices=None, axis="data",
+                 requeue_in_flight=True):
+    """Elastic recovery after chip loss (the mesh-granularity
+    equivalent of the reference's drop_slave+requeue,
+    server.py:315-338): re-form the mesh over the surviving devices,
+    re-place every step tensor (the Vector sharding setter host-syncs
+    and frees old buffers when its sharding changes), requeue
+    whatever the loader had in flight — the whole block in block
+    mode — and force the step to recompile for the new topology.
+
+    ``requeue_in_flight`` gives AT-LEAST-ONCE semantics: without a
+    commit marker there is no telling whether the interrupted
+    dispatch landed, so its minibatches re-train (pass False when the
+    caller knows the last step committed — e.g. loss detected between
+    epochs).  The in-flight record clears either way, so repeated
+    rebuilds (progressive loss 8→4→2) never double-queue.
+
+    Precondition: the training state is recoverable — parameter
+    buffers are replicated on every chip, so any surviving chip can
+    source them (a lost chip only loses its batch shard, which the
+    failed-minibatch queue re-serves).
+    """
+    import jax
+    if surviving_devices is None:
+        surviving_devices = jax.devices()
+    mesh = make_mesh(surviving_devices,
+                     {axis: len(surviving_devices)})
+    apply_dp_sharding(workflow, mesh, axis=axis)
+    # The jitted step specialized on the old device set/shardings.
+    workflow.compiler._compiled = False
+    loader = getattr(workflow, "loader", None)
+    if loader is not None:
+        in_flight = list(getattr(loader, "_in_flight_", []))
+        loader._in_flight_ = []
+        if requeue_in_flight:
+            loader.failed_minibatches.extend(in_flight)
+    return mesh
