@@ -153,3 +153,29 @@ def test_elastic_mesh_rebuild_on_chip_loss():
     assert results["min_validation_err"] < 0.12
     some_param = next(iter(wf.compiler._param_vecs.values()))
     assert len(some_param.devmem.sharding.device_set) == 4
+
+
+def test_dp_tp_sharding_2x4_mesh():
+    """Data x tensor parallelism on a 2x4 virtual mesh: FC weights
+    shard column-wise on the model axis, training still converges
+    (the natural-XLA-extension beyond the reference's DP)."""
+    import jax
+    from jax.sharding import PartitionSpec
+    from veles_tpu.parallel import make_mesh, apply_dp_tp_sharding
+    prng.reset()
+    prng.get(0).seed(1234)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, layers=(128, 12), minibatch_size=64,
+                       max_epochs=3, learning_rate=0.1)
+    launcher.initialize()
+    mesh = make_mesh(jax.devices(), {"data": 2, "model": 4})
+    apply_dp_tp_sharding(wf, mesh)
+    launcher._finished.clear()
+    wf.run()
+    results = wf.gather_results()
+    assert results["min_validation_err"] < 0.15
+    w0 = wf.forwards[0].weights
+    assert w0.devmem.sharding.spec == PartitionSpec(None, "model")
+    assert len(w0.devmem.sharding.device_set) == 8
+    vel = wf.gds[-1].tstate["velocity_weights"]
+    assert vel.devmem.sharding.spec == PartitionSpec(None, "model")

@@ -219,9 +219,21 @@ class Vector(Pickleable):
         """Device → host only when the device copy is authoritative
         AND the host copy is stale — repeat calls are free.
         ``numpy.asarray`` on a jax.Array yields a read-only view, so
-        copy into a writable buffer."""
+        copy into a writable buffer.  Fully-replicated arrays read
+        from ONE local shard — no cross-device gather, and elastic
+        recovery can source replicated params from any healthy chip
+        (parallel.rebuild_mesh)."""
         if self._devmem_ is not None and self._host_stale_:
-            self._mem = numpy.array(self._devmem_)
+            arr = self._devmem_
+            try:
+                if arr.is_fully_replicated and \
+                        arr.addressable_shards:
+                    self._mem = numpy.array(
+                        arr.addressable_shards[0].data)
+                else:
+                    self._mem = numpy.array(arr)
+            except AttributeError:  # non-sharded array types
+                self._mem = numpy.array(arr)
             self._host_stale_ = False
 
     def _free_device(self):

@@ -1,2 +1,2 @@
 from .mesh import (make_mesh, apply_dp_sharding,  # noqa: F401
-                   rebuild_mesh)
+                   apply_dp_tp_sharding, rebuild_mesh)

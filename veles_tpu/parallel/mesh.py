@@ -90,6 +90,56 @@ def apply_dp_sharding(workflow, mesh, axis="data"):
     return workflow
 
 
+def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
+                         model_axis="model"):
+    """Data × tensor parallelism over a 2-axis mesh — the "natural
+    XLA extension" beyond the reference's DP-only engine (SURVEY
+    §2.3): dense layers' weight matrices shard along their OUTPUT
+    dimension on ``model_axis`` (so each model-shard computes a slice
+    of the layer's neurons from the full input), optimizer momentum
+    shards identically, batches shard on ``data_axis``.  No manual
+    collectives: XLA's sharding propagation inserts the
+    all-gather/reduce-scatter pattern between layers and the gradient
+    psum over the data axis — the same compiled step, just annotated
+    differently.
+
+    Layers whose output width does not divide the model-axis size
+    stay replicated (correct, merely less parallel).
+    """
+    from ..znicz.all2all import All2All
+
+    apply_dp_sharding(workflow, mesh, axis=data_axis)
+    n_model = mesh.shape[model_axis]
+    col_sharded = NamedSharding(mesh,
+                                PartitionSpec(None, model_axis))
+    vec_sharded = NamedSharding(mesh, PartitionSpec(model_axis))
+    gd_of = {gd.target: gd
+             for gd in getattr(workflow, "gds", [])
+             if getattr(gd, "target", None) is not None}
+    for unit in getattr(workflow, "forwards", []):
+        if not isinstance(unit, All2All):
+            continue
+        weights = unit.trainables.get("weights")
+        if weights is None or not weights or \
+                weights.shape[-1] % n_model:
+            continue
+        weights.sharding = col_sharded
+        bias = unit.trainables.get("bias")
+        if bias:
+            bias.sharding = vec_sharded
+        gd = gd_of.get(unit)
+        if gd is not None:
+            # Momentum buffers mirror their parameter's layout.
+            for name, vec in gd.tstate.items():
+                if not vec:
+                    continue
+                if len(vec.shape) == 2:
+                    vec.sharding = col_sharded
+                elif len(vec.shape) == 1:
+                    vec.sharding = vec_sharded
+    return workflow
+
+
 def rebuild_mesh(workflow, surviving_devices=None, axis="data",
                  requeue_in_flight=True):
     """Elastic recovery after chip loss (the mesh-granularity
@@ -107,10 +157,14 @@ def rebuild_mesh(workflow, surviving_devices=None, axis="data",
     epochs).  The in-flight record clears either way, so repeated
     rebuilds (progressive loss 8→4→2) never double-queue.
 
-    Precondition: the training state is recoverable — parameter
-    buffers are replicated on every chip, so any surviving chip can
-    source them (a lost chip only loses its batch shard, which the
-    failed-minibatch queue re-serves).
+    Precondition: the jax runtime is still serving reads — parameter
+    buffers are replicated, and the host-sync path reads a LOCAL
+    addressable shard for replicated arrays (memory._host_sync), so a
+    healthy chip sources them; a lost chip only loses its batch
+    shard, which the failed-minibatch queue re-serves.  When the
+    runtime itself died with the chip (the common real-hardware
+    failure), recovery is snapshot-resume (snapshotter.py), not this
+    in-process path.
     """
     import jax
     if surviving_devices is None:
