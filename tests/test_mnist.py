@@ -179,3 +179,31 @@ def test_dp_tp_sharding_2x4_mesh():
     assert len(w0.devmem.sharding.device_set) == 8
     vel = wf.gds[-1].tstate["velocity_weights"]
     assert vel.devmem.sharding.spec == PartitionSpec(None, "model")
+
+
+def test_rebuild_preserves_tp_layout():
+    """rebuild_mesh keeps the dp x tp layout over the shrunk mesh
+    when the survivor count still fits 2 x n/2."""
+    import jax
+    from jax.sharding import PartitionSpec
+    from veles_tpu.parallel import (make_mesh, apply_dp_tp_sharding,
+                                    rebuild_mesh)
+    prng.reset()
+    prng.get(0).seed(7)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, layers=(128, 12), minibatch_size=64,
+                       max_epochs=2, learning_rate=0.1)
+    launcher.initialize()
+    apply_dp_tp_sharding(wf, make_mesh(jax.devices(),
+                                       {"data": 2, "model": 4}))
+    launcher._finished.clear()
+    wf.run()
+    rebuild_mesh(wf, jax.devices()[:4])
+    wf.decision.max_epochs = 4
+    wf.decision.complete <<= False
+    wf._finished_.clear()
+    wf.run()
+    w0 = wf.forwards[0].weights
+    assert w0.devmem.sharding.spec == PartitionSpec(None, "model")
+    assert len(w0.devmem.sharding.device_set) == 4
+    assert wf.gather_results()["epochs"] == 4

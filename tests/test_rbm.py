@@ -148,3 +148,30 @@ def test_kohonen_som_organizes():
     centers = rng.rand(4, 2).astype(numpy.float32)
     for c in centers:
         assert numpy.sqrt(((w - c) ** 2).sum(1)).min() < 0.15
+
+
+def test_kohonen_sample_workflow_cli():
+    """The SOM sample launches through velescli (full Main.run path,
+    config override applied) and organizes (parity: znicz Kohonen
+    samples)."""
+    import os
+    from veles_tpu.__main__ import Main
+    from veles_tpu.config import root
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sample = os.path.join(repo, "veles_tpu", "znicz", "samples",
+                          "kohonen.py")
+    prng.reset()
+    try:
+        m = Main([sample, "root.kohonen.max_epochs=12",
+                  "--random-seed", "5", "-v", "warning"])
+        assert m.run() == 0
+        wf = m.workflow
+        assert wf.decision.epoch_number == 12  # override applied
+        qe = wf.quantization_error()
+        assert qe < 0.1  # blobs spread 0.02: organized map sits close
+        u = wf.umatrix()
+        assert u.shape == (8, 8)
+        assert numpy.isfinite(u).all()
+    finally:
+        root.kohonen.reset()

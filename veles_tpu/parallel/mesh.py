@@ -87,6 +87,7 @@ def apply_dp_sharding(workflow, mesh, axis="data"):
         else:
             vec.sharding = replicated
     workflow.mesh = mesh
+    workflow._parallel_style_ = ("dp", axis)
     return workflow
 
 
@@ -129,14 +130,17 @@ def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
             bias.sharding = vec_sharded
         gd = gd_of.get(unit)
         if gd is not None:
-            # Momentum buffers mirror their parameter's layout.
+            # Optimizer slots mirror their NAMED parameter's layout
+            # ("velocity_weights" rides weights' sharding) — rank
+            # heuristics would mis-shard future non-mirror slots.
+            param_sharding = {"weights": col_sharded,
+                              "bias": vec_sharded if bias else None}
             for name, vec in gd.tstate.items():
-                if not vec:
-                    continue
-                if len(vec.shape) == 2:
-                    vec.sharding = col_sharded
-                elif len(vec.shape) == 1:
-                    vec.sharding = vec_sharded
+                for pname, sh in param_sharding.items():
+                    if sh is not None and name.endswith(pname):
+                        vec.sharding = sh
+                        break
+    workflow._parallel_style_ = ("dp_tp", data_axis, model_axis)
     return workflow
 
 
@@ -169,9 +173,26 @@ def rebuild_mesh(workflow, surviving_devices=None, axis="data",
     import jax
     if surviving_devices is None:
         surviving_devices = jax.devices()
-    mesh = make_mesh(surviving_devices,
-                     {axis: len(surviving_devices)})
-    apply_dp_sharding(workflow, mesh, axis=axis)
+    n = len(surviving_devices)
+    style = getattr(workflow, "_parallel_style_", None) or \
+        ("dp", axis)
+    if style[0] == "dp_tp" and n >= 4 and n % 2 == 0:
+        # Keep the tensor-parallel layout over the shrunk mesh
+        # (host-syncing model-sharded params gathers across the OLD
+        # device set — fine while the runtime still serves reads,
+        # the documented precondition).
+        mesh = make_mesh(surviving_devices,
+                         {style[1]: 2, style[2]: n // 2})
+        apply_dp_tp_sharding(workflow, mesh, data_axis=style[1],
+                             model_axis=style[2])
+    else:
+        if style[0] == "dp_tp":
+            workflow.warning(
+                "rebuild_mesh: %d survivors cannot hold the 2-axis "
+                "dp×tp layout — falling back to data parallelism"
+                % n)
+        mesh = make_mesh(surviving_devices, {axis: n})
+        apply_dp_sharding(workflow, mesh, axis=axis)
     # The jitted step specialized on the old device set/shardings.
     workflow.compiler._compiled = False
     loader = getattr(workflow, "loader", None)
