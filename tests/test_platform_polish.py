@@ -102,3 +102,42 @@ def test_nodes_local_spawns_worker_end_to_end(tmp_path):
     # the spawned worker process was tracked and reaped
     assert len(m.launcher._worker_procs) >= 1
     root.mnist.reset()
+
+
+def test_compare_snapshots(tmp_path):
+    """compare_snapshots reports identical pickles as identical and
+    diverged training as drifted (reference:
+    scripts/compare_snapshots.py)."""
+    import gzip
+    import pickle
+    from veles_tpu.scripts.compare_snapshots import compare
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+    prng.reset()
+    prng.get(0).seed(3)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=1, learning_rate=0.1)
+    launcher.initialize()
+    launcher.run()
+    a = tmp_path / "a.pickle.gz"
+    with gzip.open(a, "wb") as fout:
+        pickle.dump(wf, fout)
+    # Same state pickled twice -> identical.
+    b_same = tmp_path / "b.pickle.gz"
+    with gzip.open(b_same, "wb") as fout:
+        pickle.dump(wf, fout)
+    report = compare(str(a), str(b_same))
+    assert report["identical"]
+    # Train one more epoch -> weights drift.
+    wf.decision.max_epochs = 2
+    wf.decision.complete <<= False
+    wf._finished_.clear()
+    wf.run()
+    b_diff = tmp_path / "c.pickle.gz"
+    with gzip.open(b_diff, "wb") as fout:
+        pickle.dump(wf, fout)
+    report = compare(str(a), str(b_diff))
+    assert not report["identical"]
+    drifted = [r for r in report["tensors"]
+               if r["status"] == "ok" and r["max_abs"] > 0]
+    assert any("weights" in r["tensor"] for r in drifted)

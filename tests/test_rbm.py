@@ -92,17 +92,10 @@ def test_kohonen_som_organizes():
                                              StepCompiler)
     from veles_tpu.launcher import Launcher
     from veles_tpu.plumbing import Repeater
-    from veles_tpu.loader.fullbatch import FullBatchLoader
     from veles_tpu.znicz.decision import DecisionBase
-
-    class BlobLoader(FullBatchLoader):
-        def load_data(self):
-            rng = numpy.random.RandomState(0)
-            centers = rng.rand(4, 2).astype(numpy.float32)
-            pts = numpy.concatenate([
-                c + rng.normal(0, 0.02, (100, 2)) for c in centers])
-            self.original_data.mem = pts.astype(numpy.float32)
-            self.class_lengths = [0, 0, 400]
+    # One blob generator for both this test and the sample — the
+    # spread/cluster parameters are load-bearing for the thresholds.
+    from veles_tpu.znicz.samples.kohonen import BlobLoader
 
     prng.reset()
     prng.get(0).seed(5)

@@ -117,6 +117,7 @@ def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
     gd_of = {gd.target: gd
              for gd in getattr(workflow, "gds", [])
              if getattr(gd, "target", None) is not None}
+    sharded_layers = 0
     for unit in getattr(workflow, "forwards", []):
         if not isinstance(unit, All2All):
             continue
@@ -128,18 +129,26 @@ def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
         bias = unit.trainables.get("bias")
         if bias:
             bias.sharding = vec_sharded
+        sharded_layers += 1
         gd = gd_of.get(unit)
         if gd is not None:
-            # Optimizer slots mirror their NAMED parameter's layout
-            # ("velocity_weights" rides weights' sharding) — rank
-            # heuristics would mis-shard future non-mirror slots.
-            param_sharding = {"weights": col_sharded,
-                              "bias": vec_sharded if bias else None}
+            # Optimizer slots that MIRROR a parameter's shape ride
+            # its sharding (velocity_weights ≡ weights); anything
+            # non-mirror stays replicated — shape matching cannot
+            # mis-shard the way name/rank heuristics can.
             for name, vec in gd.tstate.items():
-                for pname, sh in param_sharding.items():
-                    if sh is not None and name.endswith(pname):
-                        vec.sharding = sh
-                        break
+                if not vec:
+                    continue
+                if tuple(vec.shape) == tuple(weights.shape):
+                    vec.sharding = col_sharded
+                elif bias and \
+                        tuple(vec.shape) == tuple(bias.shape):
+                    vec.sharding = vec_sharded
+    if sharded_layers == 0:
+        workflow.warning(
+            "apply_dp_tp_sharding: no dense layer width divides the "
+            "model axis (%d) — the workflow runs data-parallel only"
+            % n_model)
     workflow._parallel_style_ = ("dp_tp", data_axis, model_axis)
     return workflow
 
@@ -176,13 +185,28 @@ def rebuild_mesh(workflow, surviving_devices=None, axis="data",
     n = len(surviving_devices)
     style = getattr(workflow, "_parallel_style_", None) or \
         ("dp", axis)
-    if style[0] == "dp_tp" and n >= 4 and n % 2 == 0:
+    data_size = None
+    if style[0] == "dp_tp":
+        # Preserve the OLD data-axis size when it still divides the
+        # survivor count (so the model axis — which layer widths
+        # were validated against — shrinks as little as possible);
+        # fall back to data=2, then to dp-only.
+        old_mesh = getattr(workflow, "mesh", None)
+        old_data = (old_mesh.shape.get(style[1])
+                    if old_mesh is not None else None)
+        for candidate in (old_data, 2):
+            if candidate and n % candidate == 0 and \
+                    n // candidate >= 2:
+                data_size = candidate
+                break
+    if data_size is not None:
         # Keep the tensor-parallel layout over the shrunk mesh
         # (host-syncing model-sharded params gathers across the OLD
         # device set — fine while the runtime still serves reads,
         # the documented precondition).
         mesh = make_mesh(surviving_devices,
-                         {style[1]: 2, style[2]: n // 2})
+                         {style[1]: data_size,
+                          style[2]: n // data_size})
         apply_dp_tp_sharding(workflow, mesh, data_axis=style[1],
                              model_axis=style[2])
     else:
