@@ -302,3 +302,33 @@ class TestReviewRegressions:
         finally:
             httpd.shutdown()
             httpd.server_close()
+
+
+class TestSpectrogram:
+    def test_shapes_and_tone_separation(self, tmp_path):
+        from veles_tpu.loader.audio import SpectrogramLoader
+
+        for i, freq in enumerate((400, 3200)):
+            d = tmp_path / ("tone%d" % i)
+            d.mkdir()
+            t = numpy.linspace(0, 1, 8000)
+            _write_wav(d / "a.wav",
+                       0.8 * numpy.sin(2 * numpy.pi * freq * t))
+        loader = SpectrogramLoader(
+            DummyWorkflow(), window_size=2000, fft_size=256,
+            train_paths=[str(tmp_path / "tone0"),
+                         str(tmp_path / "tone1")])
+        loader.load_data()
+        n_frames = (2000 - 256) // 128 + 1
+        assert loader.original_data.mem.shape == (8, n_frames, 129)
+        # Tones concentrate energy in different bins: the argmax bin
+        # of each class's mean spectrum must differ.
+        spec = loader.original_data.mem
+        labels = loader.original_labels.mem
+        peak0 = spec[labels == 0].mean(axis=(0, 1)).argmax()
+        peak1 = spec[labels == 1].mean(axis=(0, 1)).argmax()
+        assert peak0 != peak1
+        # 400 Hz at 8 kHz rate with 256-bin FFT -> bin ~12.8; 3200 Hz
+        # -> bin ~102.4.
+        assert abs(int(peak0) - 13) <= 2
+        assert abs(int(peak1) - 102) <= 3

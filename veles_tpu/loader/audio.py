@@ -209,3 +209,53 @@ class AudioFileLoader(FullBatchLoader):
         self.original_labels.mem = numpy.asarray(
             labels, dtype=numpy.int32)
         self.class_lengths = lengths
+
+
+class SpectrogramLoader(AudioFileLoader):
+    """Windowed log-spectrogram features (the reference's audio
+    feature-extraction role, veles/scripts music_features +
+    libsndfile_loader): each window becomes a (frames, bins)
+    log-magnitude STFT computed once at load time — features are
+    static per dataset, so paying the FFT once beats recomputing it
+    every epoch on device.
+
+    kwargs on top of AudioFileLoader: ``fft_size`` (per-frame FFT,
+    default 256), ``hop`` (frame hop, default fft_size//2),
+    ``log_floor`` (dB-ish clamp, default 1e-6).
+    """
+
+    MAPPING = "audio_spectrogram"
+
+    def __init__(self, workflow, **kwargs):
+        super(SpectrogramLoader, self).__init__(workflow, **kwargs)
+        self.fft_size = int(kwargs.get("fft_size", 256))
+        self.hop = int(kwargs.get("hop", self.fft_size // 2))
+        self.log_floor = float(kwargs.get("log_floor", 1e-6))
+        if self.hop <= 0:
+            raise BadFormatError("hop must be positive (got %d)"
+                                 % self.hop)
+        if self.window_size < self.fft_size:
+            raise BadFormatError(
+                "window_size (%d) must be >= fft_size (%d) — no "
+                "frame fits" % (self.window_size, self.fft_size))
+        self._hann = numpy.hanning(self.fft_size).astype(
+            numpy.float32)
+
+    def _spectrogram(self, window):
+        # One vectorized rfft over all frames (per-frame Python FFTs
+        # would cost millions of tiny calls on large datasets).
+        frames = numpy.lib.stride_tricks.sliding_window_view(
+            window, self.fft_size)[::self.hop] * self._hann
+        mag = numpy.abs(numpy.fft.rfft(frames, axis=-1))
+        return numpy.log(numpy.maximum(
+            mag, self.log_floor)).astype(numpy.float32)
+
+    def load_data(self):
+        super(SpectrogramLoader, self).load_data()
+        raw = self.original_data.mem
+        if raw.ndim != 2:
+            raise BadFormatError(
+                "SpectrogramLoader needs mono windows (got shape %s)"
+                % (raw.shape,))
+        self.original_data.mem = numpy.stack(
+            [self._spectrogram(w) for w in raw])
