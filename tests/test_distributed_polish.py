@@ -18,7 +18,6 @@ import veles_tpu.prng as prng
 from veles_tpu.client import Client
 from veles_tpu.config import root
 from veles_tpu.launcher import Launcher
-from veles_tpu.network_common import machine_id
 from veles_tpu.server import Server
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -11,8 +11,7 @@ import pytest
 
 import veles_tpu.prng as prng
 from veles_tpu.config import root, Tune
-from veles_tpu.genetics import (Chromosome, Population, collect_tunes,
-                                GeneticsOptimizer,
+from veles_tpu.genetics import (Population, collect_tunes,
                                 OptimizationWorkflow)
 from veles_tpu.genetics.core import apply_genes
 from veles_tpu.error import Bug

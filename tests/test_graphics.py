@@ -14,7 +14,6 @@ from veles_tpu.config import root
 from veles_tpu.graphics_client import GraphicsClient
 from veles_tpu.graphics_server import GraphicsServer
 from veles_tpu.launcher import Launcher
-from veles_tpu.memory import Vector
 from veles_tpu.plotting_units import (AccumulatingPlotter, Histogram,
                                       ImagePlotter, MatrixPlotter,
                                       MultiHistogram, TableMaxMin,

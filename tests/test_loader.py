@@ -1,7 +1,6 @@
 """Loader contract tests (mirrors reference loader tests)."""
 
 import numpy
-import pytest
 
 import veles_tpu.prng as prng
 from veles_tpu.dummy import DummyWorkflow

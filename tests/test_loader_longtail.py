@@ -5,7 +5,6 @@ image.py padding, hdfs_loader.py)."""
 
 import http.server
 import json
-import os
 import threading
 import wave
 
@@ -13,7 +12,7 @@ import numpy
 import pytest
 
 from veles_tpu.dummy import DummyWorkflow
-from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.loader.base import TRAIN
 
 
 def _write_wav(path, samples, rate=8000):

@@ -1,7 +1,6 @@
 """Loader family + normalization registry tests (reference analogue:
 veles/tests/test_normalization.py and the loader tests)."""
 
-import os
 import pickle
 
 import numpy

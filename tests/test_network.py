@@ -7,7 +7,6 @@ import threading
 import time
 
 import numpy
-import pytest
 
 import veles_tpu.prng as prng
 from veles_tpu.client import Client

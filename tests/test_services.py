@@ -6,7 +6,6 @@ veles/scripts/generate_frontend.py)."""
 import json
 import os
 
-import numpy
 import pytest
 
 import veles_tpu.prng as prng

@@ -4,7 +4,6 @@
 import os
 
 import numpy
-import pytest
 
 import veles_tpu.prng as prng
 from veles_tpu.launcher import Launcher

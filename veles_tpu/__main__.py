@@ -28,7 +28,7 @@ import sys
 import time
 
 from .cmdline import CommandLineBase, init_argparser
-from .config import root, get as config_get
+from .config import root
 from .error import Bug
 from .json_encoders import dump_json
 from .launcher import Launcher

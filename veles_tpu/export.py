@@ -22,7 +22,6 @@ the native runtime.
 
 import io
 import json
-import os
 import struct
 import tarfile
 import time

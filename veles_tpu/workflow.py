@@ -29,7 +29,7 @@ from .error import Bug
 from .mutable import Bool
 from .plumbing import StartPoint, EndPoint
 from .result_provider import IResultProvider
-from .units import Unit, Container
+from .units import Container
 
 
 class Workflow(Container):

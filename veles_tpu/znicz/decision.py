@@ -10,12 +10,10 @@ Host-side by design: metrics are tiny scalars fetched from the device
 once per tick (the only per-tick device→host sync in the fused design).
 """
 
-import numpy
-
 from ..mutable import Bool
 from ..result_provider import IResultProvider
 from ..units import Unit
-from ..loader.base import TRAIN, VALID, TEST, CLASS_NAME
+from ..loader.base import TRAIN, VALID, CLASS_NAME
 
 
 class DecisionBase(Unit):

@@ -18,7 +18,6 @@ import numpy
 
 from .. import prng
 from ..accelerated_units import TracedUnit
-from ..config import root, get as config_get
 from ..memory import Vector
 from ..registry import MappedUnitRegistry
 
