@@ -117,3 +117,13 @@ def test_python_dash_m_entry(tmp_path):
         cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert json.loads(result.read_text())["results"]["epochs"] == 1
+
+
+def test_frontend_flag_generates_wizard(tmp_path):
+    """`python -m veles_tpu --frontend FILE` emits the wizard and
+    exits (reference: velescli --frontend)."""
+    out = tmp_path / "wiz.html"
+    rc = Main(["--frontend", str(out)]).run()
+    assert rc == 0
+    page = out.read_text()
+    assert "--optimize" in page and "compose()" in page

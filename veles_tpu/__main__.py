@@ -326,6 +326,18 @@ class Main(Logger, CommandLineBase):
     def run(self):
         self._start_time = time.time()
         self.parse()
+        if self.args.frontend:
+            # The wizard needs no workflow (reference: --frontend,
+            # __main__.py:251-325 spawned the web wizard).
+            try:
+                from .scripts.generate_frontend import generate
+                path = generate(self.args.frontend)
+            except Exception:
+                self.exception("frontend generation failed")
+                return self.EXIT_FAILURE
+            self.info("frontend wizard -> %s", path)
+            print(path)
+            return self.EXIT_SUCCESS
         if not self.args.workflow:
             init_argparser(prog="veles_tpu").print_help()
             return self.EXIT_FAILURE

@@ -129,6 +129,11 @@ class CommandLineBase(object):
         parser.add_argument(
             "--profile", default="", metavar="DIR",
             help="capture a jax.profiler trace of the run into DIR")
+        parser.add_argument(
+            "--frontend", nargs="?", const="frontend.html",
+            default="", metavar="FILE",
+            help="generate the HTML launch wizard (unit registry + "
+                 "full flag tree) and exit")
         return parser
 
 
