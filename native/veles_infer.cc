@@ -246,7 +246,11 @@ void run_pool(const UnitDesc &u, const float *in, float *out,
               }
             }
           }
-          yp[j] = is_avg ? (count ? sum / count : 0.0f) : best;
+          /* A window lying entirely in padding: the Python parity
+           * path (_pool_numpy) reduces an all-NaN slice → NaN for
+           * the max variants, 0.0 for avg. Mirror that. */
+          yp[j] = is_avg ? (count ? sum / count : 0.0f)
+                         : (first ? std::nanf("") : best);
         }
       }
   }
@@ -283,6 +287,36 @@ void run_mean_disp(const UnitDesc &u, const float *in, float *out,
 
 /* ---- shape propagation (mirror of export geometry) ------------------ */
 
+/* Looks up a required param and checks its element count against the
+ * config-derived geometry. The executors index param arrays by that
+ * geometry, so a model.bin whose dims are self-consistent with its
+ * data but inconsistent with the config must be rejected here, not
+ * read out of bounds later. */
+const Param *checked_param(const UnitDesc &u, const char *pname,
+                           size_t want) {
+  auto it = u.params.find(pname);
+  if (it == u.params.end()) {
+    set_error("unit " + u.name + ": missing param " + pname);
+    return nullptr;
+  }
+  if (it->second.data.size() != want) {
+    set_error("unit " + u.name + ": param " + pname + " has " +
+              std::to_string(it->second.data.size()) +
+              " elements, geometry wants " + std::to_string(want));
+    return nullptr;
+  }
+  return &it->second;
+}
+
+bool check_optional_bias(const UnitDesc &u, size_t want) {
+  auto it = u.params.find("bias");
+  if (it != u.params.end() && it->second.data.size() != want) {
+    set_error("unit " + u.name + ": bias size mismatch");
+    return false;
+  }
+  return true;
+}
+
 bool infer_shapes(VtModel *m) {
   for (size_t i = 0; i < m->units.size(); ++i) {
     const UnitDesc &u = m->units[i];
@@ -290,30 +324,81 @@ bool infer_shapes(VtModel *m) {
     Shape so = si;
     const std::string &t = u.type;
     if (t.rfind("all2all", 0) == 0 || t == "softmax") {
-      so = Shape{1, 1, (int)u.cfgv("n_out"), false};
+      const int n_out = (int)u.cfgv("n_out");
+      if (n_out <= 0) {
+        set_error("unit " + u.name + ": bad n_out");
+        return false;
+      }
+      if (!checked_param(u, "weights", (size_t)si.size() * n_out) ||
+          !check_optional_bias(u, (size_t)n_out))
+        return false;
+      so = Shape{1, 1, n_out, false};
     } else if (t.rfind("conv", 0) == 0) {
-      const Param &w = u.params.at("weights");
-      const int ky = w.dims[0], kx = w.dims[1];
+      auto wit = u.params.find("weights");
+      if (wit == u.params.end() || wit->second.dims.size() != 4) {
+        set_error("unit " + u.name + ": conv weights must be HWIO");
+        return false;
+      }
+      const Param &w = wit->second;
+      const int ky = w.dims[0], kx = w.dims[1], ci = w.dims[2],
+                co = w.dims[3];
+      if (ky <= 0 || kx <= 0 || ci <= 0 || co <= 0) {
+        set_error("unit " + u.name + ": bad conv kernel dims");
+        return false;
+      }
+      /* run_conv walks the input with ci = w.dims[2]; it must match
+       * the propagated channel count or reads go out of bounds. */
+      if (ci != si.c) {
+        set_error("unit " + u.name + ": conv expects " +
+                  std::to_string(ci) + " input channels, activation "
+                  "has " + std::to_string(si.c));
+        return false;
+      }
+      if (!check_optional_bias(u, (size_t)co)) return false;
       const int sh = (int)u.cfgv("stride_h", 1),
                 sw = (int)u.cfgv("stride_w", 1);
+      if (sh <= 0 || sw <= 0) {
+        set_error("unit " + u.name + ": bad conv stride");
+        return false;
+      }
       const int ph = (int)(u.cfgv("pad_top") + u.cfgv("pad_bottom"));
       const int pw = (int)(u.cfgv("pad_left") + u.cfgv("pad_right"));
       so.h = (si.h + ph - ky) / sh + 1;
       so.w = (si.w + pw - kx) / sw + 1;
-      so.c = (int)w.dims[3];
+      so.c = co;
       so.spatial = true;
+      if (so.h <= 0 || so.w <= 0) {
+        set_error("unit " + u.name + ": conv output collapses");
+        return false;
+      }
     } else if (t.find("pooling") != std::string::npos) {
       const int ky = (int)u.cfgv("ky"), kx = (int)u.cfgv("kx");
       const int sh = (int)u.cfgv("stride_h", 1),
                 sw = (int)u.cfgv("stride_w", 1);
+      if (ky <= 0 || kx <= 0 || sh <= 0 || sw <= 0) {
+        set_error("unit " + u.name + ": bad pooling geometry");
+        return false;
+      }
       const int ph = (int)(u.cfgv("pad_top") + u.cfgv("pad_bottom"));
       const int pw = (int)(u.cfgv("pad_left") + u.cfgv("pad_right"));
       /* ceil mode (znicz pools the ragged tail) */
       so.h = (si.h + ph - ky + sh - 1) / sh + 1;
       so.w = (si.w + pw - kx + sw - 1) / sw + 1;
-    } else if (t == "norm" || t == "dropout" ||
-               t.rfind("activation_", 0) == 0 || t == "mean_disp") {
-      /* shape-preserving */
+      if (so.h <= 0 || so.w <= 0) {
+        set_error("unit " + u.name + ": pooling output collapses");
+        return false;
+      }
+    } else if (t == "norm") {
+      if ((int)u.cfgv("n") <= 0) {
+        set_error("unit " + u.name + ": bad LRN window");
+        return false;
+      }
+    } else if (t == "mean_disp") {
+      if (!checked_param(u, "mean", (size_t)si.size()) ||
+          !checked_param(u, "rdisp", (size_t)si.size()))
+        return false;
+    } else if (t == "dropout" || t.rfind("activation_", 0) == 0) {
+      /* shape-preserving, no params */
     } else {
       set_error("unknown unit type: " + t);
       return false;
@@ -340,16 +425,29 @@ bool parse_model(const uint8_t *data, size_t size, VtModel *m) {
   }
   const uint32_t n_units = c.read<uint32_t>();
   const uint32_t in_ndim = c.read<uint32_t>();
+  if (!c.ok || in_ndim == 0 || in_ndim > 8) {
+    set_error("bad input ndim");
+    return false;
+  }
   std::vector<uint32_t> in_shape(in_ndim);
-  for (auto &d : in_shape) d = c.read<uint32_t>();
+  uint64_t in_count = 1;
+  for (auto &d : in_shape) {
+    d = c.read<uint32_t>();
+    /* Same discipline as params: hostile dims must fail here, not
+     * overflow Shape::size() into a small/negative int that defeats
+     * every downstream geometry check. */
+    if (!c.ok || d == 0 || in_count > (uint64_t)INT32_MAX / d) {
+      set_error("bad input shape");
+      return false;
+    }
+    in_count *= d;
+  }
   Shape s0;
   if (in_ndim == 3) {
     s0 = Shape{(int)in_shape[0], (int)in_shape[1], (int)in_shape[2],
                true};
   } else {
-    int flat = 1;
-    for (auto d : in_shape) flat *= (int)d;
-    s0 = Shape{1, 1, flat, false};
+    s0 = Shape{1, 1, (int)in_count, false};
   }
   m->shapes.push_back(s0);
   for (uint32_t i = 0; i < n_units && c.ok; ++i) {
@@ -366,10 +464,21 @@ bool parse_model(const uint8_t *data, size_t size, VtModel *m) {
       std::string pname = c.read_str();
       Param p;
       const uint32_t ndim = c.read<uint32_t>();
+      if (ndim > 8) {
+        set_error("param ndim too large");
+        return false;
+      }
       uint64_t count = 1;
       for (uint32_t d = 0; d < ndim && c.ok; ++d) {
-        p.dims.push_back(c.read<uint32_t>());
-        count *= p.dims.back();
+        const uint32_t dim = c.read<uint32_t>();
+        p.dims.push_back(dim);
+        /* Checked multiply: huge dims must fail, not wrap the
+         * product below the truncation bound. */
+        if (dim != 0 && count > UINT64_MAX / dim) {
+          set_error("param dims overflow");
+          return false;
+        }
+        count *= dim;
       }
       /* Overflow-safe bound: compare against remaining bytes, never
        * via pointer arithmetic that huge dims could wrap. */

@@ -104,3 +104,28 @@ def test_native_rejects_garbage(tmp_path):
     from veles_tpu.error import Bug
     with pytest.raises(Bug):
         NativeModel(str(bad))
+
+
+def test_native_rejects_geometry_mismatch(tmp_path):
+    """A model.bin whose param dims are self-consistent with the data
+    but inconsistent with the config geometry must be rejected at
+    load, not read out of bounds at run time."""
+    import struct
+
+    def s(txt):
+        b = txt.encode()
+        return struct.pack("<H", len(b)) + b
+
+    blob = b"VTPM" + struct.pack("<III", 1, 1, 1)
+    blob += struct.pack("<I", 4)              # input shape (4,)
+    blob += s("all2all") + s("fc")
+    blob += struct.pack("<I", 1) + s("n_out") + struct.pack("<d", 8.0)
+    # weights 4x4 = 16 floats, but geometry wants 4*8 = 32
+    blob += struct.pack("<I", 1) + s("weights")
+    blob += struct.pack("<III", 2, 4, 4)
+    blob += struct.pack("<16f", *([0.5] * 16))
+    bad = tmp_path / "mismatch.bin"
+    bad.write_bytes(blob)
+    from veles_tpu.error import Bug
+    with pytest.raises(Bug):
+        NativeModel(str(bad))

@@ -3,6 +3,7 @@ capability: veles/web_status.py:113-243 + launcher.py:853-886)."""
 
 import json
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -113,3 +114,39 @@ def test_launcher_heartbeats_reach_dashboard(status_server):
     assert info["mode"] == "standalone"
     assert info["epoch"] >= 1
     assert "validation_err" in info.get("metrics", {})
+
+
+def test_heartbeat_html_is_escaped(status_server):
+    """Heartbeat JSON is network-supplied; hostile field values must
+    not become live markup in the dashboard."""
+    _post(status_server.port, "/update", {
+        "id": "evil", "workflow": "<script>alert(1)</script>",
+        "mode": "master", "runtime": "NaN-ish",
+        "slaves": {"<img src=x>": {"state": "<b>w</b>",
+                                   "jobs_done": 1}}})
+    page = _get(status_server.port, "/")
+    assert "<script>alert" not in page
+    assert "&lt;script&gt;" in page
+    assert "<img src=x>" not in page
+
+
+def test_post_token_enforcement():
+    """With a token configured, unauthenticated POSTs are 403 and the
+    launcher-side header opens the door."""
+    srv = WebStatusServer(host="127.0.0.1", port=0,
+                          token="sekrit").start()
+    try:
+        payload = {"id": "m1", "workflow": "W"}
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/update" % srv.port,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 403
+        req.add_header("X-Status-Token", "sekrit")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read()) == {"commands": []}
+        assert "m1" in srv.status()
+    finally:
+        srv.stop()

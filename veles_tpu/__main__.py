@@ -343,10 +343,13 @@ class Main(Logger, CommandLineBase):
             return self.EXIT_FAILURE
         try:
             self.seed_random()
-            self.apply_subsystem_flags()
             apply_config_sources(
                 list(self.args.config) + list(self.args.config_list),
                 logger=self)
+            # After config sources so explicit CLI flags win over
+            # config-file assignments (reference precedence:
+            # __main__.py:467 applies argv overrides last).
+            self.apply_subsystem_flags()
             self.module = import_workflow_module(self.args.workflow)
             if self.args.dump_config:
                 root.print_()

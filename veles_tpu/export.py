@@ -351,8 +351,12 @@ class ExportedModel(object):
                     y[:, oy, ox] = flat.sum(axis=1) / \
                         numpy.maximum(cnt, 1.0)
                 elif t == "maxabs_pooling":
-                    idx = numpy.nanargmax(
-                        numpy.abs(flat), axis=1)
+                    # nan→-inf (not nanargmax: an all-padding window
+                    # must yield NaN, matching the native runtime,
+                    # rather than raise on the all-NaN slice).
+                    absf = numpy.where(numpy.isnan(flat),
+                                       -numpy.inf, numpy.abs(flat))
+                    idx = absf.argmax(axis=1)
                     y[:, oy, ox] = numpy.take_along_axis(
                         flat, idx[:, None, :], axis=1)[:, 0]
                 else:

@@ -98,6 +98,15 @@ class ForgeClient(Logger):
             try:
                 tar.extractall(dest_dir, filter="data")
             except TypeError:  # Python < 3.12
+                # No "data" filter here, so symlink/hardlink/device
+                # members could write outside dest_dir — reject them
+                # on this fallback only (the filter above permits
+                # safe in-tree symlinks).
+                for member in tar.getmembers():
+                    if not (member.isreg() or member.isdir()):
+                        raise BadFormatError(
+                            "non-regular member %r (type %r)"
+                            % (member.name, member.type))
                 tar.extractall(dest_dir)
         self.info("fetched %s@%s -> %s", name, got_version, dest_dir)
         return dest_dir, got_version

@@ -60,6 +60,8 @@ class Launcher(Logger):
         self.heartbeat_interval = float(kwargs.get(
             "heartbeat_interval",
             config_get(root.common.web.interval, 5.0)))
+        self.status_token = kwargs.get(
+            "status_token", config_get(root.common.web.token, None))
         self._heartbeat_thread = None
         self._heartbeat_stop = threading.Event()
         self.graphics_server = None
@@ -249,10 +251,13 @@ class Launcher(Logger):
         mid = "%s/%d" % (machine_id(), os.getpid())
         while not self._heartbeat_stop.wait(self.heartbeat_interval):
             try:
+                headers = {"Content-Type": "application/json"}
+                if self.status_token:
+                    headers["X-Status-Token"] = self.status_token
                 req = urllib.request.Request(
                     url, data=dumps_json(
                         self.status_payload(mid)).encode(),
-                    headers={"Content-Type": "application/json"})
+                    headers=headers)
                 with urllib.request.urlopen(req, timeout=10) as resp:
                     reply = json.loads(resp.read())
                 for cmd in reply.get("commands", []):

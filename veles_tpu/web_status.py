@@ -19,6 +19,8 @@ Stale masters (no heartbeat for ``expiry`` seconds) are dropped, the
 reference's garbage-collection behavior.
 """
 
+import hmac
+import html
 import json
 import threading
 import time
@@ -44,8 +46,10 @@ th {{ background: #eee; }}
 class WebStatusServer(JsonHttpServer):
     """The dashboard server (reference: web_status.py:113)."""
 
-    def __init__(self, host="0.0.0.0", port=8090, expiry=30.0):
+    def __init__(self, host="127.0.0.1", port=8090, expiry=30.0,
+                 token=None):
         self.expiry = expiry
+        self.token = token
         self._masters = {}  # id -> {payload, received}
         self._commands = {}  # id -> [command dicts]
         self._lock = threading.Lock()
@@ -63,6 +67,11 @@ class WebStatusServer(JsonHttpServer):
 
             def do_POST(self):
                 outer = self.outer
+                if outer.token is not None and not hmac.compare_digest(
+                        self.headers.get("X-Status-Token") or "",
+                        outer.token):
+                    self.reply(403, {"error": "bad token"})
+                    return
                 try:
                     payload = self.read_json()
                 except ValueError:
@@ -125,24 +134,31 @@ class WebStatusServer(JsonHttpServer):
             self._commands.pop(mid, None)
 
     def render_page(self):
+        # Heartbeat JSON is network-supplied: escape every interpolated
+        # field so a hostile peer cannot store XSS into the dashboard.
+        esc = lambda v: html.escape(str(v), quote=True)  # noqa: E731
         status = self.status()
         rows = []
         for mid, info in sorted(status.items()):
             workers = info.get("slaves", {})
             wtable = "".join(
                 "<tr><td>%s</td><td>%s</td><td>%s</td></tr>" %
-                (sid, w.get("state"), w.get("jobs_done"))
+                (esc(sid), esc(w.get("state")), esc(w.get("jobs_done")))
                 for sid, w in workers.items())
+            try:
+                runtime = float(info.get("runtime", 0.0))
+            except (TypeError, ValueError):
+                runtime = 0.0
             rows.append(
                 "<h2>%s <small>(%s)</small></h2>"
                 "<table><tr><th>mode</th><td>%s</td></tr>"
                 "<tr><th>epoch</th><td>%s</td></tr>"
                 "<tr><th>runtime</th><td>%.0f s</td></tr>"
                 "<tr><th>metrics</th><td>%s</td></tr></table>" %
-                (info.get("workflow", "?"), mid,
-                 info.get("mode", "?"), info.get("epoch", "?"),
-                 info.get("runtime", 0.0),
-                 json.dumps(info.get("metrics", {}))) +
+                (esc(info.get("workflow", "?")), esc(mid),
+                 esc(info.get("mode", "?")), esc(info.get("epoch", "?")),
+                 runtime,
+                 esc(json.dumps(info.get("metrics", {})))) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
                  "</th><th>jobs</th></tr>%s</table>" % wtable
                  if workers else ""))
@@ -165,10 +181,14 @@ class WebStatusServer(JsonHttpServer):
 def main(argv=None):
     import argparse
     parser = argparse.ArgumentParser(prog="veles_tpu.web_status")
-    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8090)
+    parser.add_argument(
+        "--token", default=None,
+        help="shared secret required (X-Status-Token header) on POSTs")
     args = parser.parse_args(argv)
-    server = WebStatusServer(host=args.host, port=args.port)
+    server = WebStatusServer(host=args.host, port=args.port,
+                             token=args.token)
     try:
         server.serve()
     except KeyboardInterrupt:
