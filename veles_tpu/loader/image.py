@@ -20,25 +20,18 @@ import numpy
 from ..error import BadFormatError
 from ..normalization import normalizer_factory
 from .fullbatch import FullBatchLoader
+from .stream import StreamLoader
 
 IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif",
               ".tiff", ".ppm", ".webp")
 
 
-class ImageLoaderBase(FullBatchLoader):
-    """Common image preprocessing (reference: image.py:106).
+class ImageDecoderMixin(object):
+    """The image preprocessing pipeline shared by the resident
+    (fullbatch) and streamed image loaders (reference: image.py:106 —
+    scale / center-crop / color-space / aspect-pad)."""
 
-    kwargs: ``size`` (w, h) target scale; ``color_space`` "RGB"/"L";
-    ``crop`` optional (w, h) center crop after scale; ``mirror`` adds
-    horizontally-flipped copies of TRAIN samples;
-    ``normalization_type`` + ``normalization_parameters`` choose a
-    host normalizer from the registry.
-    """
-
-    hide_from_registry = True
-
-    def __init__(self, workflow, **kwargs):
-        super(ImageLoaderBase, self).__init__(workflow, **kwargs)
+    def init_image_kwargs(self, kwargs):
         self.size = tuple(kwargs.get("size", (32, 32)))
         self.color_space = kwargs.get("color_space", "RGB")
         self.crop = kwargs.get("crop")
@@ -54,7 +47,12 @@ class ImageLoaderBase(FullBatchLoader):
         self.normalizer = normalizer_factory(
             ntype, **kwargs.get("normalization_parameters", {}))
 
-    # -- preprocessing ------------------------------------------------------
+    @property
+    def decoded_shape(self):
+        """(h, w, c) a decoded sample comes out as."""
+        w, h = self.crop if self.crop else self.size
+        c = 1 if self.color_space == "L" else 3
+        return (h, w, c)
 
     def _background(self, shape):
         bg = numpy.asarray(self.background_color,
@@ -103,6 +101,23 @@ class ImageLoaderBase(FullBatchLoader):
             top, left = (h - ch) // 2, (w - cw) // 2
             arr = arr[top:top + ch, left:left + cw]
         return arr
+
+
+class ImageLoaderBase(FullBatchLoader, ImageDecoderMixin):
+    """Device-resident image loader base (reference: image.py:106).
+
+    kwargs: ``size`` (w, h) target scale; ``color_space`` "RGB"/"L";
+    ``crop`` optional (w, h) center crop after scale; ``mirror`` adds
+    horizontally-flipped copies of TRAIN samples;
+    ``normalization_type`` + ``normalization_parameters`` choose a
+    host normalizer from the registry.
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super(ImageLoaderBase, self).__init__(workflow, **kwargs)
+        self.init_image_kwargs(kwargs)
 
     def _finalize(self, per_class):
         """per_class: {TEST/VALID/TRAIN: (list of arrays, list of
@@ -253,3 +268,88 @@ class FileImageMSELoader(FileImageLoader):
         # normalized would silently shift the learning objective.
         self.original_targets.mem = self.normalizer.normalize(
             numpy.stack(targets)).astype(numpy.float32)
+
+
+class StreamedFileImageLoader(StreamLoader, ImageDecoderMixin):
+    """Directory-scale image streaming (reference:
+    fullbatch_image.py:56-268 + file_image.py — datasets larger than
+    memory): only the file LIST is scanned at ``load_data``; images
+    are decoded minibatch-by-minibatch by the host worker pool and
+    double-buffer-uploaded while the previous block trains (see
+    loader/stream.py).
+
+    Same kwargs as :class:`FileImageLoader` (``test_paths`` /
+    ``validation_paths`` / ``train_paths``, entries are paths,
+    directories, or (path, label) pairs) plus the streaming knobs
+    (``decode_workers``, ``prefetch``).  Normalizer state is analyzed
+    over up to ``analysis_samples`` (default 256) train images at
+    load time — a bounded pass, matching the reference's approach of
+    analyzing before streaming.  ``mirror`` is unsupported (augment
+    downstream instead of doubling the index space)."""
+
+    MAPPING = "streamed_file_image"
+
+    def __init__(self, workflow, **kwargs):
+        super(StreamedFileImageLoader, self).__init__(workflow,
+                                                      **kwargs)
+        self.init_image_kwargs(kwargs)
+        if self.mirror:
+            raise BadFormatError(
+                "mirror augmentation is not supported by the "
+                "streamed loader")
+        self.paths = {0: kwargs.get("test_paths") or [],
+                      1: kwargs.get("validation_paths") or [],
+                      2: kwargs.get("train_paths") or []}
+        self.analysis_samples = int(kwargs.get("analysis_samples",
+                                               256))
+        self._label_map = {}
+        self.files = []   # global index -> (path, label)
+
+    get_label_from_path = FileImageLoader.get_label_from_path
+    _expand = FileImageLoader._expand
+
+    def load_data(self):
+        self.files = []
+        lengths = [0, 0, 0]
+        for cls in (0, 1, 2):
+            entries = self._expand(self.paths[cls])
+            for path, label in entries:
+                self.files.append(
+                    (path, self.get_label_from_path(path)
+                     if label is None else label))
+            lengths[cls] = len(entries)
+        if not self.files:
+            raise BadFormatError("%s: no images found" % self)
+        self.class_lengths = lengths
+        self.sample_shape = self.decoded_shape
+        self.sample_dtype = numpy.float32
+        # Bounded normalizer analysis, ALWAYS at load time (the lazy
+        # analyze-on-first-normalize path is not thread-safe under the
+        # decode pool).  Train split preferred; an inference-only
+        # dataset analyzes over whatever split it has.
+        if type(self.normalizer).__name__ != "NoneNormalizer":
+            for cls in (2, 1, 0):
+                if lengths[cls] == 0:
+                    continue
+                start = sum(lengths[:cls])
+                take = min(self.analysis_samples, lengths[cls])
+                sample = numpy.stack([
+                    self.decode_image(self.files[start + i][0])
+                    for i in range(take)])
+                self.normalizer.analyze(sample)
+                break
+        self.info("streaming %d images (%d/%d/%d test/val/train), "
+                  "%d classes", len(self.files), *lengths,
+                  self.n_classes)
+
+    @property
+    def n_classes(self):
+        # Explicit (path, label) entries may carry ids beyond the
+        # auto-label map — count from the materialized labels.
+        return 1 + max(lab for _p, lab in self.files)
+
+    def materialize(self, index):
+        path, label = self.files[index]
+        arr = self.decode_image(path)
+        arr = self.normalizer.normalize(arr[None])[0]
+        return arr.astype(numpy.float32), label

@@ -225,4 +225,10 @@ def rebuild_mesh(workflow, surviving_devices=None, axis="data",
         loader._in_flight_ = []
         if requeue_in_flight:
             loader.failed_minibatches.extend(in_flight)
+        # A streamed loader's prefetched block holds device arrays
+        # placed on the PRE-rebuild device set (and its indices were
+        # just requeued above) — drop it, never dispatch it.
+        invalidate = getattr(loader, "invalidate_staged", None)
+        if invalidate is not None:
+            invalidate()
     return mesh

@@ -26,9 +26,51 @@ import os
 import numpy
 
 from ...config import root, get as config_get
+from ...loader.base import VALID
 from ...loader.fullbatch import FullBatchLoader
+from ...loader.stream import StreamLoader
 from ...mean_disp_normalizer import MeanDispNormalizer
 from ..standard_workflow import StandardWorkflow
+
+
+def fill_synthetic(out, labels, rng, s):
+    """Class-dependent spatial frequency/phase patterns + noise,
+    quantized to bytes: learnable by a conv stack, and the uint8 →
+    mean-disp path is identical to the real pipeline.  ``out`` may be
+    a plain array or a disk memmap (the streamed loader writes the
+    dataset to disk once and never holds it whole in RAM)."""
+    yy, xx = numpy.mgrid[0:s, 0:s].astype(numpy.float32) / (s - 1)
+    for i, lab in enumerate(labels):
+        freq = 1.0 + (lab % 7)
+        phase = (lab // 7) * 0.7
+        pattern = numpy.sin(2 * numpy.pi * freq * xx + phase) * \
+            numpy.cos(2 * numpy.pi * freq * yy + phase)
+        img = pattern[:, :, None] * 80.0 + 128.0 + \
+            rng.normal(0, 20.0, (s, s, 3))
+        out[i] = numpy.clip(img, 0, 255).astype(numpy.uint8)
+
+
+def analyze_mean_disp(train, chunk_bytes=1 << 28):
+    """Train-set per-pixel mean and reciprocal dispersion
+    (the reference loader's dataset analysis feeding
+    mean_disp_normalizer).  Two-pass chunked accumulation over any
+    array-like (incl. disk memmaps): the originals are never copied
+    to float wholesale, so the real-ImageNet geometry (hundreds of
+    GB) stays O(sample_shape) in extra host memory."""
+    n = len(train)
+    s = numpy.zeros(train.shape[1:], dtype=numpy.float64)
+    s2 = numpy.zeros(train.shape[1:], dtype=numpy.float64)
+    chunk = max(1, chunk_bytes // max(
+        1, int(numpy.prod(train.shape[1:])) * 8))
+    for i in range(0, n, chunk):
+        part = numpy.asarray(train[i:i + chunk],
+                             dtype=numpy.float64)
+        s += part.sum(axis=0)
+        s2 += (part * part).sum(axis=0)
+    mean = s / n
+    disp = numpy.sqrt(numpy.maximum(s2 / n - mean * mean, 0.0))
+    rdisp = 1.0 / numpy.maximum(disp, 1e-3)
+    return mean.astype(numpy.float32), rdisp.astype(numpy.float32)
 
 
 class ImagenetLoader(FullBatchLoader):
@@ -79,19 +121,8 @@ class ImagenetLoader(FullBatchLoader):
         labels = (numpy.arange(n) % self.sim_classes).astype(
             numpy.int32)
         rng.shuffle(labels)
-        # Class-dependent spatial frequency/phase patterns + noise,
-        # quantized to bytes: learnable by a conv stack, and the
-        # uint8 → mean-disp path is identical to the real pipeline.
-        yy, xx = numpy.mgrid[0:s, 0:s].astype(numpy.float32) / (s - 1)
         data = numpy.empty((n, s, s, 3), dtype=numpy.uint8)
-        for i, lab in enumerate(labels):
-            freq = 1.0 + (lab % 7)
-            phase = (lab // 7) * 0.7
-            pattern = numpy.sin(2 * numpy.pi * freq * xx + phase) * \
-                numpy.cos(2 * numpy.pi * freq * yy + phase)
-            img = pattern[:, :, None] * 80.0 + 128.0 + \
-                rng.normal(0, 20.0, (s, s, 3))
-            data[i] = numpy.clip(img, 0, 255).astype(numpy.uint8)
+        fill_synthetic(data, labels, rng, s)
         self.original_data.mem = data
         self.original_labels.mem = labels
         self.class_lengths = [0, self.sim_valid, self.sim_train]
@@ -100,29 +131,143 @@ class ImagenetLoader(FullBatchLoader):
                   self.sim_train, self.sim_valid, s, self.sim_classes)
 
     def _analyze_mean_disp(self):
-        """Train-set per-pixel mean and reciprocal dispersion
-        (the reference loader's dataset analysis feeding
-        mean_disp_normalizer).  Two-pass chunked accumulation: the
-        uint8 originals are never copied to float wholesale, so the
-        real-ImageNet geometry (hundreds of GB) stays O(sample_shape)
-        in extra host memory."""
-        from ...loader.base import VALID
         train_start = self.class_end_offsets[VALID]
-        train = self.original_data.mem[train_start:]
-        n = len(train)
-        s = numpy.zeros(train.shape[1:], dtype=numpy.float64)
-        s2 = numpy.zeros(train.shape[1:], dtype=numpy.float64)
-        chunk = max(1, (1 << 28) // max(
-            1, int(numpy.prod(train.shape[1:])) * 8))
-        for i in range(0, n, chunk):
-            part = train[i:i + chunk].astype(numpy.float64)
-            s += part.sum(axis=0)
-            s2 += (part * part).sum(axis=0)
-        mean = s / n
-        disp = numpy.sqrt(numpy.maximum(s2 / n - mean * mean, 0.0))
-        self.mean.mem = mean.astype(numpy.float32)
-        self.rdisp.mem = (1.0 / numpy.maximum(disp, 1e-3)).astype(
-            numpy.float32)
+        mean, rdisp = analyze_mean_disp(
+            self.original_data.mem[train_start:])
+        self.mean.mem = mean
+        self.rdisp.mem = rdisp
+
+
+class StreamedImagenetLoader(StreamLoader):
+    """Streamed (non-HBM-resident) ImageNet loader — the reference's
+    directory-scale path (reference: veles/loader/fullbatch_image.py:
+    56-268): the dataset lives ON DISK as ``.npy`` files and is
+    memmapped; each block of minibatches is read + staged by the host
+    worker pool and double-buffer-uploaded while the previous block
+    trains (see loader/stream.py).
+
+    Sources, in order: ``{train,valid}_data.npy`` + labels under
+    ``root.common.dirs.datasets/imagenet`` (same contract as
+    :class:`ImagenetLoader`); otherwise a synthetic uint8 dataset is
+    written to disk ONCE under ``cache_dir`` and memmapped from there —
+    so even the fallback streams from real files, never from resident
+    memory."""
+
+    MAPPING = "imagenet_stream_loader"
+
+    def __init__(self, workflow, **kwargs):
+        super(StreamedImagenetLoader, self).__init__(workflow,
+                                                     **kwargs)
+        from ...memory import Vector
+        self.mean = Vector()
+        self.rdisp = Vector()
+        self.sim_image_size = kwargs.get("sim_image_size", 227)
+        self.sim_classes = kwargs.get("sim_classes", 1000)
+        self.sim_train = kwargs.get("sim_train", 2048)
+        self.sim_valid = kwargs.get("sim_valid", 256)
+        self.cache_dir = kwargs.get("cache_dir")
+
+    def init_unpickled(self):
+        super(StreamedImagenetLoader, self).init_unpickled()
+        self._sources_ = None  # [(memmap_data, memmap_labels), ...]
+
+    def load_data(self):
+        d = os.path.join(config_get(root.common.dirs.datasets, "."),
+                         "imagenet")
+        names = ("valid_data.npy", "valid_labels.npy",
+                 "train_data.npy", "train_labels.npy")
+        paths = [os.path.join(d, n) for n in names]
+        if not all(map(os.path.isfile, paths)):
+            paths = self._write_synthetic()
+        valid = numpy.load(paths[0], mmap_mode="r")
+        valid_l = numpy.load(paths[1], mmap_mode="r")
+        train = numpy.load(paths[2], mmap_mode="r")
+        train_l = numpy.load(paths[3], mmap_mode="r")
+        self._sources_ = [(valid, valid_l), (train, train_l)]
+        self.class_lengths = [0, len(valid), len(train)]
+        self.sample_shape = tuple(train.shape[1:])
+        self.sample_dtype = train.dtype
+        mean, rdisp = self._cached_mean_disp(paths[2], train)
+        self.mean.mem = mean
+        self.rdisp.mem = rdisp
+        self.info("streaming imagenet from disk: %d train + %d "
+                  "validation (%s, %s)", len(train), len(valid),
+                  "x".join(map(str, self.sample_shape)),
+                  self.sample_dtype)
+
+    def _cached_mean_disp(self, train_path, train):
+        """mean/rdisp are a pure function of the (immutable) train
+        file — cache them beside it so a restart/resume on the real
+        hundreds-of-GB geometry costs O(sample_shape), not a full
+        sequential disk pass."""
+        cache = train_path + ".meandisp.npz"
+        st = os.stat(train_path)
+        key = numpy.array([st.st_size, int(st.st_mtime)],
+                          dtype=numpy.int64)
+        if os.path.isfile(cache):
+            try:
+                with numpy.load(cache) as z:
+                    if numpy.array_equal(z["key"], key):
+                        return z["mean"], z["rdisp"]
+            except Exception:
+                pass  # corrupt cache → recompute
+        mean, rdisp = analyze_mean_disp(train)
+        try:
+            numpy.savez(cache + ".tmp.npz", key=key, mean=mean,
+                        rdisp=rdisp)
+            os.replace(cache + ".tmp.npz", cache)
+        except OSError:
+            self.warning("mean/disp cache not writable at %s", cache)
+        return mean, rdisp
+
+    def _write_synthetic(self):
+        """Synthesizes the dataset to disk once (chunked through a
+        memmap — host RAM stays O(chunk))."""
+        import tempfile
+        cache = self.cache_dir or os.path.join(
+            tempfile.gettempdir(), "veles_tpu_imagenet_%dx%d_%d" % (
+                self.sim_train, self.sim_image_size,
+                self.sim_classes))
+        os.makedirs(cache, exist_ok=True)
+        s = self.sim_image_size
+        sizes = {"valid": self.sim_valid, "train": self.sim_train}
+        out = []
+        rng = numpy.random.RandomState(0)
+        for part in ("valid", "train"):
+            dpath = os.path.join(cache, "%s_data.npy" % part)
+            lpath = os.path.join(cache, "%s_labels.npy" % part)
+            n = sizes[part]
+            if not (os.path.isfile(dpath) and os.path.isfile(lpath)):
+                labels = (numpy.arange(n) % self.sim_classes).astype(
+                    numpy.int32)
+                rng.shuffle(labels)
+                mm = numpy.lib.format.open_memmap(
+                    dpath + ".tmp", mode="w+", dtype=numpy.uint8,
+                    shape=(n, s, s, 3))
+                fill_synthetic(mm, labels, rng, s)
+                mm.flush()
+                del mm
+                numpy.save(lpath, labels)
+                os.replace(dpath + ".tmp", dpath)
+                self.info("wrote synthetic %s set -> %s", part, dpath)
+            out.extend([dpath, lpath])
+        # Order: valid_data, valid_labels, train_data, train_labels.
+        return out
+
+    def fill_rows(self, indices, out_data, out_labels):
+        """Vectorized memmap reads (the 'decode' of the npy source)."""
+        n_valid = self.class_lengths[VALID]
+        indices = numpy.asarray(indices)
+        is_train = indices >= n_valid
+        for src_id, sel in ((0, ~is_train), (1, is_train)):
+            if not sel.any():
+                continue
+            data, labels = self._sources_[src_id]
+            local = indices[sel] - (n_valid if src_id else 0)
+            # memmap fancy indexing → one read per row, no wholesale
+            # load.
+            out_data[sel] = data[local]
+            out_labels[sel] = labels[local]
 
 
 def alexnet_layers(n_classes=1000, lr=0.01, moment=0.9, decay=5e-4):

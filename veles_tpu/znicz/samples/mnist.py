@@ -112,13 +112,15 @@ class MnistWorkflow(AcceleratedWorkflow):
     def __init__(self, workflow, layers=(100, 10), minibatch_size=100,
                  learning_rate=0.03, gradient_moment=0.9,
                  weights_decay=0.0005, max_epochs=None,
-                 fail_iterations=25, loader_cls=MnistLoader, **kwargs):
+                 fail_iterations=25, loader_cls=MnistLoader,
+                 loader_config=None, **kwargs):
         super(MnistWorkflow, self).__init__(workflow, **kwargs)
 
         self.repeater = Repeater(self)
         self.repeater.link_from(self.start_point)
 
-        self.loader = loader_cls(self, minibatch_size=minibatch_size)
+        self.loader = loader_cls(self, minibatch_size=minibatch_size,
+                                 **(loader_config or {}))
         self.loader.link_from(self.repeater)
 
         # Forward stack: tanh hiddens + softmax output.
