@@ -51,10 +51,21 @@ ALEXNET_TICKS_PER_DISPATCH = 32
 ALEXNET_N_TRAIN = 16384
 ALEXNET_N_VALID = 512
 
-#: Analytic AlexNet training cost (fwd conv+FC MACs ×2 FLOP ×3 for
-#: fwd+bwd+wgrad at 227px/1000 classes ≈ 0.72 GMAC fwd) — used only
-#: for the reported TFLOP/s / MFU diagnostics.
-ALEXNET_TRAIN_GFLOP_PER_IMG = 4.33
+#: Analytic AlexNet training cost for the network THIS bench runs —
+#: the UNGROUPED variant (no 2-way filter groups; grouping was a
+#: 2-GPU memory workaround, not a capability).  Forward MACs at
+#: 227px/1000 classes:
+#:   conv1 55·55·96·11·11·3   = 105.4 M
+#:   conv2 27·27·256·5·5·96   = 447.9 M   (grouped would be half)
+#:   conv3 13·13·384·3·3·256  = 149.5 M
+#:   conv4 13·13·384·3·3·384  = 224.3 M   (grouped would be half)
+#:   conv5 13·13·256·3·3·384  = 149.5 M   (grouped would be half)
+#:   fc6 9216·4096 + fc7 4096·4096 + fc8 4096·1000 = 58.6 M
+#:   total ≈ 1.135 GMAC fwd → ×2 FLOP/MAC ×3 (fwd+dgrad+wgrad)
+#: ≈ 6.81 GF/img trained.  (Round 3 reported MFU with the GROUPED
+#: constant 4.33 — a 1.57× undercount for this net; see
+#: BENCHNOTES.md.)  Used only for TFLOP/s / MFU diagnostics.
+ALEXNET_TRAIN_GFLOP_PER_IMG = 6.81
 TPU_V5E_PEAK_BF16_TFLOPS = 197.0
 
 MLP_BATCH = 100

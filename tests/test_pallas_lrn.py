@@ -1,0 +1,94 @@
+"""Pallas LRN kernel parity tests (SURVEY §7 milestone 2 Pallas
+homes; reference role: znicz normalization kernels in ocl/cuda).
+
+The kernel itself targets TPU; here it runs in Pallas interpret mode
+on the CPU mesh, checked against the banded-matmul reference
+formulation (the production in-step path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy
+import pytest
+
+from veles_tpu.ops.pallas_lrn import (band_matrix, lrn_pallas,
+                                      lrn_reference)
+
+N, ALPHA, BETA, K = 5, 1e-4, 0.75, 2.0
+
+
+@pytest.mark.parametrize("shape,dtype", [
+    ((4, 7, 7, 96), jnp.float32),
+    ((2, 5, 5, 64), jnp.bfloat16),
+    ((64, 32), jnp.float32),
+])
+def test_forward_parity(shape, dtype):
+    x = jax.random.normal(jax.random.PRNGKey(0), shape,
+                          jnp.float32).astype(dtype)
+    want = lrn_reference(x, N, ALPHA, BETA, K)
+    got = lrn_pallas(x, N, ALPHA, BETA, K, True)
+    tol = 5e-2 if dtype == jnp.bfloat16 else 1e-4
+    numpy.testing.assert_allclose(
+        numpy.asarray(got, numpy.float32),
+        numpy.asarray(want, numpy.float32), rtol=tol, atol=tol)
+
+
+def test_backward_parity():
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 7, 7, 96),
+                          jnp.float32)
+
+    def loss_ref(v):
+        return jnp.sum(lrn_reference(v, N, ALPHA, BETA, K) ** 2)
+
+    def loss_pal(v):
+        return jnp.sum(lrn_pallas(v, N, ALPHA, BETA, K, True) ** 2)
+
+    g_ref = jax.grad(loss_ref)(x)
+    g_pal = jax.grad(loss_pal)(x)
+    numpy.testing.assert_allclose(numpy.asarray(g_pal),
+                                  numpy.asarray(g_ref),
+                                  rtol=1e-3, atol=1e-4)
+
+
+def test_even_window_band_asymmetry():
+    """Even n: the window is asymmetric ([j-half, j+n-1-half]),
+    matching znicz's padded slice-add semantics."""
+    band = numpy.asarray(band_matrix(6, 4))
+    # Channel 2's window: inputs 0..3 (half=2 below, n-1-half=1 above).
+    numpy.testing.assert_array_equal(
+        band[:, 2], [1.0, 1.0, 1.0, 1.0, 0.0, 0.0])
+
+
+def test_unit_flag_dispatch():
+    """root.common.engine.pallas_lrn=True routes the LRN unit through
+    the ops dispatcher (which falls back to the reference formulation
+    off-TPU) without changing results."""
+    from veles_tpu.config import root
+    from veles_tpu.dummy import DummyWorkflow
+    from veles_tpu.memory import Vector
+    from veles_tpu.znicz.lrn import LRNormalizerForward
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (3, 4, 4, 16),
+                          jnp.float32)
+
+    def run_unit():
+        wf = DummyWorkflow()
+        unit = LRNormalizerForward(wf, alpha=ALPHA, beta=BETA, k=K,
+                                   n=N)
+        unit.input = Vector()
+        unit.input.mem = numpy.asarray(x)
+        unit.initialize()
+        out = {}
+        unit.tforward(lambda v: jnp.asarray(v.mem),
+                      lambda v, val: out.setdefault("y", val),
+                      {}, type("Ctx", (), {"training": False})())
+        return numpy.asarray(out["y"])
+
+    root.common.engine.pallas_lrn = False
+    y_banded = run_unit()
+    root.common.engine.pallas_lrn = True
+    try:
+        y_dispatched = run_unit()
+    finally:
+        root.common.engine.pallas_lrn = False
+    numpy.testing.assert_allclose(y_dispatched, y_banded,
+                                  rtol=1e-5, atol=1e-6)

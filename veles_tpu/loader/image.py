@@ -143,18 +143,12 @@ class ImageLoaderBase(FullBatchLoader, ImageDecoderMixin):
         self.class_lengths = lengths
 
 
-class FileImageLoader(ImageLoaderBase):
-    """Explicit file lists per class (reference: file_image.py:53).
+class FileListMixin(object):
+    """Per-class path lists + auto-labeling, shared by the resident
+    and streamed file loaders (reference: file_image.py:53 path
+    handling)."""
 
-    kwargs ``test_paths``/``validation_paths``/``train_paths``: lists
-    whose entries are image paths or (path, label) pairs; plain paths
-    get label from ``get_label_from_path`` (filename prefix by
-    default)."""
-
-    MAPPING = "file_image"
-
-    def __init__(self, workflow, **kwargs):
-        super(FileImageLoader, self).__init__(workflow, **kwargs)
+    def init_path_kwargs(self, kwargs):
         self.paths = {0: kwargs.get("test_paths") or [],
                       1: kwargs.get("validation_paths") or [],
                       2: kwargs.get("train_paths") or []}
@@ -180,6 +174,21 @@ class FileImageLoader(ImageLoaderBase):
             else:
                 out.append((e, None))
         return out
+
+
+class FileImageLoader(ImageLoaderBase, FileListMixin):
+    """Explicit file lists per class (reference: file_image.py:53).
+
+    kwargs ``test_paths``/``validation_paths``/``train_paths``: lists
+    whose entries are image paths or (path, label) pairs; plain paths
+    get label from ``get_label_from_path`` (filename prefix by
+    default)."""
+
+    MAPPING = "file_image"
+
+    def __init__(self, workflow, **kwargs):
+        super(FileImageLoader, self).__init__(workflow, **kwargs)
+        self.init_path_kwargs(kwargs)
 
     def load_data(self):
         per_class = {}
@@ -270,7 +279,8 @@ class FileImageMSELoader(FileImageLoader):
             numpy.stack(targets)).astype(numpy.float32)
 
 
-class StreamedFileImageLoader(StreamLoader, ImageDecoderMixin):
+class StreamedFileImageLoader(StreamLoader, ImageDecoderMixin,
+                              FileListMixin):
     """Directory-scale image streaming (reference:
     fullbatch_image.py:56-268 + file_image.py — datasets larger than
     memory): only the file LIST is scanned at ``load_data``; images
@@ -297,16 +307,10 @@ class StreamedFileImageLoader(StreamLoader, ImageDecoderMixin):
             raise BadFormatError(
                 "mirror augmentation is not supported by the "
                 "streamed loader")
-        self.paths = {0: kwargs.get("test_paths") or [],
-                      1: kwargs.get("validation_paths") or [],
-                      2: kwargs.get("train_paths") or []}
+        self.init_path_kwargs(kwargs)
         self.analysis_samples = int(kwargs.get("analysis_samples",
                                                256))
-        self._label_map = {}
         self.files = []   # global index -> (path, label)
-
-    get_label_from_path = FileImageLoader.get_label_from_path
-    _expand = FileImageLoader._expand
 
     def load_data(self):
         self.files = []

@@ -167,16 +167,16 @@ class StreamLoader(Loader, TracedUnit):
     def _fill_current(self):
         """Synchronous fill of the single current minibatch (eager
         path + worker-side materialization)."""
-        mb = self.max_minibatch_size
-        data = numpy.zeros((mb,) + tuple(self.sample_shape),
-                           dtype=self.sample_dtype)
-        labels = numpy.zeros(mb, dtype=numpy.int32)
-        n = self.minibatch_size
-        if n:
-            d, l = self._fill_block(
+        if self.minibatch_size:
+            data, labels = self._fill_block(
                 self.minibatch_indices.mem[None, :],
                 self.minibatch_mask.mem[None, :])
-            data, labels = d[0], l[0]
+            data, labels = data[0], labels[0]
+        else:
+            mb = self.max_minibatch_size
+            data = numpy.zeros((mb,) + tuple(self.sample_shape),
+                               dtype=self.sample_dtype)
+            labels = numpy.zeros(mb, dtype=numpy.int32)
         self.minibatch_data.mem = data
         self.minibatch_labels.mem = labels
 
@@ -217,8 +217,7 @@ class StreamLoader(Loader, TracedUnit):
         blocks = {
             str(id(self.minibatch_data)): jax.device_put(data),
             str(id(self.minibatch_labels)): jax.device_put(labels),
-            str(id(self.minibatch_mask)): jax.device_put(
-                masks.astype(numpy.float32)),
+            str(id(self.minibatch_mask)): jax.device_put(masks),
             str(id(self.minibatch_class_vec)): jax.device_put(cls_arr),
         }
         return {"blocks": blocks, "flags": flags,

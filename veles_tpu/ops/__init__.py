@@ -1,0 +1,4 @@
+"""Hand-written TPU kernels (Pallas) for ops where XLA's default
+lowering leaves bandwidth on the table.  Each module exposes an
+``*_reference`` pure-jnp twin used for CPU execution and parity
+tests."""

@@ -45,28 +45,18 @@ class LRNormalizerForward(ForwardBase):
         self.output.initialize(self.device)
 
     def tforward(self, read, write, params, ctx, state=None):
-        import jax.numpy as jnp
+        """Banded-matmul LRN by default; a Pallas one-pass kernel
+        exists (ops/pallas_lrn.py) but measured SLOWER inside the
+        fused step on v5e (see BENCHNOTES.md) — XLA's fusion already
+        holds this op near the layout-limited bandwidth roofline —
+        so the Pallas path is opt-in via
+        ``root.common.engine.pallas_lrn = True``."""
+        from ..config import root, get as config_get
+        from ..ops.pallas_lrn import lrn, lrn_reference
         x = read(self.input)
-        c = x.shape[-1]
-        half = self.n // 2
-        i = jnp.arange(c)
-        # Window for output channel j covers input channels
-        # [j-half, j+(n-1-half)] — asymmetric when n is even,
-        # matching the padded slice-add formulation it replaces.
-        d = i[:, None] - i[None, :]  # input minus output channel
-        band = ((d >= -half) &
-                (d <= self.n - 1 - half)).astype(jnp.float32)
-        # The squares stay in the activation dtype: the banded matmul
-        # rounds its operands to bf16 on the MXU anyway, so an f32
-        # square would buy 0 extra bits in the sum while DOUBLING the
-        # HBM traffic of the largest intermediate in the net (the
-        # conv1 activation square) — this op is bandwidth-bound, not
-        # FLOP-bound.  Accumulation is f32 via preferred_element_type,
-        # the denominator math runs in f32.
-        sq = x * x
-        ssum = jnp.einsum("...c,cd->...d", sq,
-                          band.astype(x.dtype),
-                          preferred_element_type=jnp.float32)
-        denom = (self.k + (self.alpha / self.n) * ssum) ** self.beta
-        write(self.output,
-              (x.astype(jnp.float32) / denom).astype(x.dtype))
+        if config_get(root.common.engine.pallas_lrn, False):
+            y = lrn(x, self.n, self.alpha, self.beta, self.k)
+        else:
+            y = lrn_reference(x, self.n, self.alpha, self.beta,
+                              self.k)
+        write(self.output, y)
