@@ -186,6 +186,25 @@ class Main(Logger, CommandLineBase):
                 slave_kwargs["measure_power"] = True
             if slave_kwargs:
                 kw["slave_kwargs"] = slave_kwargs
+        if self.args.jax_coordinator or self.args.jax_num_processes:
+            if not (self.args.jax_coordinator and
+                    self.args.jax_num_processes > 1):
+                # A partially-specified distributed launch silently
+                # training N independent standalone copies is the
+                # worst failure mode — refuse loudly.
+                raise Bug(
+                    "--jax-coordinator and --jax-num-processes (>1) "
+                    "must be given together (got coordinator=%r, "
+                    "num_processes=%r)" % (
+                        self.args.jax_coordinator,
+                        self.args.jax_num_processes))
+            # Multi-controller SPMD (launcher.py:120
+            # jax.distributed.initialize): every process runs the
+            # same program over the combined mesh.
+            kw["mode"] = "distributed"
+            kw["coordinator_address"] = self.args.jax_coordinator
+            kw["num_processes"] = self.args.jax_num_processes
+            kw["process_id"] = self.args.jax_process_id
         return kw
 
     def apply_subsystem_flags(self):

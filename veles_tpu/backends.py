@@ -81,7 +81,12 @@ class Device(Logger, metaclass=BackendRegistry):
     def jax_devices(self):
         if self._jax_devices is None:
             import jax
-            self._jax_devices = jax.devices()
+            # Local (addressable) devices: under multi-controller
+            # jax.distributed, jax.devices() is the GLOBAL list whose
+            # first entries belong to process 0 — placing unsharded
+            # uploads there would crash every other process.  Global
+            # meshes are built explicitly (parallel.make_mesh).
+            self._jax_devices = jax.local_devices()
         return self._jax_devices
 
     @property
@@ -233,8 +238,9 @@ class CPUDevice(Device):
     def jax_devices(self):
         if self._jax_devices is None:
             import jax
-            self._jax_devices = [d for d in jax.devices()
-                                 if d.platform == "cpu"] or jax.devices()
+            self._jax_devices = [d for d in jax.local_devices()
+                                 if d.platform == "cpu"] or \
+                jax.local_devices()
         return self._jax_devices
 
 

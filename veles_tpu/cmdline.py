@@ -85,6 +85,17 @@ class CommandLineBase(object):
                  "('local' spawns subprocesses on this machine); "
                  "dropped workers respawn the same way")
         parser.add_argument(
+            "--jax-coordinator", default="", metavar="HOST:PORT",
+            help="multi-controller SPMD: jax.distributed coordinator "
+                 "address (every process runs the same program over "
+                 "the combined device mesh)")
+        parser.add_argument(
+            "--jax-num-processes", type=int, default=0, metavar="N",
+            help="multi-controller SPMD: total process count")
+        parser.add_argument(
+            "--jax-process-id", type=int, default=0, metavar="I",
+            help="multi-controller SPMD: this process's index")
+        parser.add_argument(
             "-r", "--random-seed", default="", metavar="SPEC",
             help="seed spec: an integer, or file:count:dtype "
                  "(e.g. /dev/urandom:16:uint32)")

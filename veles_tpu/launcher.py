@@ -119,14 +119,36 @@ class Launcher(Logger):
         from . import backends
         if self._mode == "distributed" and self.num_processes > 1:
             import jax
-            jax.distributed.initialize(
-                coordinator_address=self.coordinator_address,
-                num_processes=self.num_processes,
-                process_id=self.process_id)
+            # Idempotent across launchers in one process (genetics/
+            # ensembles build a Launcher per candidate run).
+            if not jax.distributed.is_initialized():
+                jax.distributed.initialize(
+                    coordinator_address=self.coordinator_address,
+                    num_processes=self.num_processes,
+                    process_id=self.process_id)
         self.device = kwargs.pop("device", None) or \
             backends.Device.create(
                 config_get(root.common.engine.backend, "auto"))
         self.workflow.initialize(device=self.device, **kwargs)
+        if self._mode == "distributed" and self.num_processes > 1:
+            if hasattr(self.workflow, "compiler"):
+                # Multi-controller SPMD: annotate the step for data
+                # parallelism over the COMBINED mesh (every process
+                # runs the same program; XLA's psum rides the
+                # cross-process collective backend).
+                import jax
+                from .parallel import make_mesh, apply_dp_sharding
+                apply_dp_sharding(self.workflow,
+                                  make_mesh(jax.devices()))
+                self.info("distributed SPMD: %d processes, %d "
+                          "devices", self.num_processes,
+                          len(jax.devices()))
+            else:
+                self.warning(
+                    "distributed mode requested but %s has no fused-"
+                    "step compiler — every process will run the FULL "
+                    "workflow redundantly", type(self.workflow).
+                    __name__)
         if self.is_master and self.listen_address:
             from .server import Server
             self.server = Server(self.listen_address, self.workflow,

@@ -230,6 +230,16 @@ class Vector(Pickleable):
                         arr.addressable_shards:
                     self._mem = numpy.array(
                         arr.addressable_shards[0].data)
+                elif not arr.is_fully_addressable:
+                    # Multi-controller SPMD: a data-sharded array
+                    # spans other processes' devices.  All processes
+                    # run the same program, so they reach this read
+                    # in lockstep — gather the global value
+                    # collectively.
+                    from jax.experimental import multihost_utils
+                    self._mem = numpy.array(
+                        multihost_utils.process_allgather(
+                            arr, tiled=True))
                 else:
                     self._mem = numpy.array(arr)
             except AttributeError:  # non-sharded array types
