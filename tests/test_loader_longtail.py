@@ -12,7 +12,9 @@ import numpy
 import pytest
 
 from veles_tpu.dummy import DummyWorkflow
+from veles_tpu.error import BadFormatError
 from veles_tpu.loader.base import TRAIN
+from veles_tpu.loader.fullbatch import FullBatchLoader
 
 
 def _write_wav(path, samples, rate=8000):
@@ -331,3 +333,70 @@ class TestSpectrogram:
         # -> bin ~102.4.
         assert abs(int(peak0) - 13) <= 2
         assert abs(int(peak1) - 102) <= 3
+
+
+# -- dataset analysis (reference: loader/base.py:753) --------------------
+
+class _AnalyzedLoader(FullBatchLoader):
+    """Configurable synthetic dataset for analyze_dataset tests."""
+
+    def __init__(self, workflow, train_labels, valid_labels,
+                 **kwargs):
+        self._train_labels = numpy.asarray(train_labels)
+        self._valid_labels = numpy.asarray(valid_labels)
+        super(_AnalyzedLoader, self).__init__(workflow, **kwargs)
+
+    def load_data(self):
+        n = len(self._valid_labels) + len(self._train_labels)
+        self.original_data.mem = numpy.zeros((n, 4),
+                                             dtype=numpy.float32)
+        self.original_labels.mem = numpy.concatenate(
+            [self._valid_labels, self._train_labels]).astype(
+                self._train_labels.dtype)
+        self.class_lengths = [0, len(self._valid_labels),
+                              len(self._train_labels)]
+
+
+def _make(train, valid, **kw):
+    loader = _AnalyzedLoader(DummyWorkflow(), train, valid,
+                             minibatch_size=4, **kw)
+    loader.initialize()
+    return loader
+
+
+def test_analyze_dataset_reports_stats():
+    loader = _make([0, 1, 0, 1, 0, 1], [0, 1])
+    assert loader.label_stats["train"]["classes"] == 2
+    assert loader.label_stats["validation"]["classes"] == 2
+
+
+def test_analyze_dataset_rejects_unseen_validation_label():
+    """A validation label never seen in training would surface as
+    silently-bad accuracy — it must fail loudly at initialize."""
+    with pytest.raises(BadFormatError, match="never seen"):
+        _make([0, 1, 0, 1], [0, 7])
+
+
+def test_analyze_dataset_rejects_negative_labels():
+    with pytest.raises(BadFormatError, match="negative"):
+        _make([0, -3, 1, 0], [0, 1])
+
+
+def test_analyze_dataset_rejects_float_labels():
+    with pytest.raises(BadFormatError, match="integers"):
+        _make(numpy.array([0.5, 1.0]), numpy.array([0.5]))
+
+
+def test_analyze_dataset_warns_on_imbalance(caplog):
+    import logging
+    with caplog.at_level(logging.WARNING):
+        _make([0] * 40 + [1] * 2, [0, 1])
+    assert any("imbalanced" in r.message for r in caplog.records)
+
+
+def test_analyze_dataset_warns_on_distribution_drift(caplog):
+    import logging
+    with caplog.at_level(logging.WARNING):
+        _make([0, 1] * 20, [0] * 20 + [1])
+    assert any("deviates from train" in r.message
+               for r in caplog.records)

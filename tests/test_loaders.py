@@ -165,8 +165,8 @@ def test_minibatch_saver_roundtrip(tmp_path):
         def load_data(self):
             self.original_data.mem = numpy.arange(
                 30, dtype=numpy.float32).reshape(10, 3)
-            self.original_labels.mem = numpy.arange(
-                10, dtype=numpy.int32)
+            self.original_labels.mem = (numpy.arange(10) % 3).astype(
+                numpy.int32)
             self.class_lengths = [0, 4, 6]
 
         def fill_minibatch(self):

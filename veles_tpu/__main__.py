@@ -186,18 +186,23 @@ class Main(Logger, CommandLineBase):
                 slave_kwargs["measure_power"] = True
             if slave_kwargs:
                 kw["slave_kwargs"] = slave_kwargs
-        if self.args.jax_coordinator or self.args.jax_num_processes:
+        if self.args.jax_coordinator or self.args.jax_num_processes \
+                or self.args.jax_process_id:
             if not (self.args.jax_coordinator and
-                    self.args.jax_num_processes > 1):
+                    self.args.jax_num_processes > 1 and
+                    0 <= self.args.jax_process_id <
+                    self.args.jax_num_processes):
                 # A partially-specified distributed launch silently
                 # training N independent standalone copies is the
                 # worst failure mode — refuse loudly.
                 raise Bug(
-                    "--jax-coordinator and --jax-num-processes (>1) "
-                    "must be given together (got coordinator=%r, "
-                    "num_processes=%r)" % (
+                    "--jax-coordinator, --jax-num-processes (>1) and "
+                    "a --jax-process-id in [0, N) must be given "
+                    "together (got coordinator=%r, num_processes=%r, "
+                    "process_id=%r)" % (
                         self.args.jax_coordinator,
-                        self.args.jax_num_processes))
+                        self.args.jax_num_processes,
+                        self.args.jax_process_id))
             # Multi-controller SPMD (launcher.py:120
             # jax.distributed.initialize): every process runs the
             # same program over the combined mesh.

@@ -262,7 +262,9 @@ class TPUDevice(Device):
     def jax_devices(self):
         if self._jax_devices is None:
             import jax
-            devices = jax.devices()
+            # Local devices only — see Device.jax_devices (multi-host
+            # placement must never target another process's chips).
+            devices = jax.local_devices()
             if devices[0].platform not in ("tpu", "axon"):
                 raise DeviceNotFoundError(
                     "no TPU platform available (got %s)" %

@@ -93,6 +93,10 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         # (reference: --train-ratio flag, loader/base.py).
         self.train_ratio = kwargs.get(
             "train_ratio", _root.common.loader.get("train_ratio", 1.0))
+        # Strict dataset analysis (unseen-label rejection) can be
+        # opted out for datasets whose labels are not classification
+        # classes (e.g. per-sample ids).
+        self.validate_labels = kwargs.get("validate_labels", True)
         super(Loader, self).__init__(workflow, **kwargs)
         self.view_group = "LOADER"
         # Per-tick outputs (host scalars + device vectors).
@@ -182,8 +186,117 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self.minibatch_class_vec.mem = numpy.zeros(
             (), dtype=numpy.int32)
         self.create_minibatch_data()
+        self.analyze_dataset()
         if not resumed:
             self.shuffle()
+
+    # -- dataset analysis (reference: base.py:753 analyze_dataset) ---------
+
+    def dataset_labels(self):
+        """Per-class label arrays ``[test, validation, train]`` (None
+        entries for classes without labels; return None to skip
+        analysis entirely).  Subclasses with materialized labels
+        override this."""
+        return None
+
+    def slice_labels_by_class(self, labels):
+        """Splits a flat [test|validation|train] label array by the
+        class offsets.  The train slice runs to the END of the array,
+        not to the (possibly train_ratio-shrunk) offset, so analysis
+        always covers the full stored train set."""
+        out, start = [], 0
+        for cls, end in enumerate(self.class_end_offsets):
+            stop = len(labels) if cls == TRAIN else end
+            out.append(labels[start:stop] if stop > start else None)
+            start = end
+        return out
+
+    def analyze_dataset(self):
+        """Sanity-checks the loaded dataset at initialize (reference:
+        base.py:753 + _setup_labels_mapping:922): per-class sample
+        counts, label-range/mapping validation (a validation or test
+        label never seen in training fails LOUDLY — it would
+        otherwise surface as silently bad accuracy), per-class label
+        histograms with imbalance warnings, and a train-vs-other
+        distribution comparison."""
+        self.info("dataset: %s",
+                  ", ".join("%d %s" % (n, CLASS_NAME[cls])
+                            for cls, n in
+                            enumerate(self.class_lengths) if n))
+        labels = self.dataset_labels()
+        if labels is None:
+            return
+        self.label_stats = {}
+        histograms = {}
+        for cls, arr in enumerate(labels):
+            if arr is None or not len(arr):
+                continue
+            arr = numpy.asarray(arr)
+            if not numpy.issubdtype(arr.dtype, numpy.integer) or \
+                    arr.min() < 0:
+                problem = ("%s labels are not non-negative integers "
+                           "(dtype %s)" % (CLASS_NAME[cls],
+                                           arr.dtype))
+                if self.validate_labels:
+                    raise BadFormatError(
+                        problem + " — pass validate_labels=False if "
+                        "these are not class labels")
+                # Opted out: ids/regression targets — skip histogram
+                # analysis for this class.
+                self.info("%s; skipping label analysis", problem)
+                continue
+            values, counts = numpy.unique(arr, return_counts=True)
+            histograms[cls] = dict(zip(values.tolist(),
+                                       counts.tolist()))
+        if not histograms:
+            return
+        train_hist = histograms.get(TRAIN, {})
+        for cls, hist in histograms.items():
+            if cls != TRAIN and train_hist and self.validate_labels:
+                # Mapping validation: every evaluated label must be
+                # learnable (reference _validate_and_fix_other_labels).
+                unseen = sorted(set(hist) - set(train_hist))
+                if unseen:
+                    raise BadFormatError(
+                        "%s set contains labels never seen in "
+                        "training: %s (pass validate_labels=False "
+                        "if these are not class labels)"
+                        % (CLASS_NAME[cls], unseen[:10]))
+            counts = numpy.array(list(hist.values()), dtype=float)
+            mean, std = counts.mean(), counts.std()
+            self.label_stats[CLASS_NAME[cls]] = {
+                "classes": len(hist),
+                "min": int(counts.min()), "max": int(counts.max()),
+                "mean": float(mean), "std": float(std)}
+            msg = ("%s labels: %d classes, count min %d / mean %d / "
+                   "max %d (std %d)" % (CLASS_NAME[cls], len(hist),
+                                        counts.min(), mean,
+                                        counts.max(), std))
+            if std > mean / 2:
+                self.warning("%s — SEVERELY imbalanced", msg)
+            elif std > mean / 10:
+                self.warning("%s — imbalanced", msg)
+            else:
+                self.info("%s", msg)
+        # Distribution drift: a validation/test set whose label mix
+        # differs wildly from training skews the reported metrics
+        # (reference _compare_label_distributions).
+        if train_hist:
+            total_train = sum(train_hist.values())
+            for cls in (TEST, VALID):
+                hist = histograms.get(cls)
+                if not hist:
+                    continue
+                total = sum(hist.values())
+                drift = max(
+                    abs(hist.get(lbl, 0) / total -
+                        cnt / total_train)
+                    for lbl, cnt in train_hist.items())
+                if drift > 0.1:
+                    self.warning(
+                        "%s label distribution deviates from train "
+                        "by up to %.0f%%", CLASS_NAME[cls],
+                        drift * 100)
 
     def shuffle(self):
         """Shuffles ONLY the train tail of the index space

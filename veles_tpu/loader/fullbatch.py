@@ -57,6 +57,13 @@ class FullBatchLoader(Loader, TracedUnit):
                 (mb,) + tuple(self.original_targets.shape[1:]),
                 dtype=self.original_targets.dtype)
 
+    def dataset_labels(self):
+        """Class-sliced views of the resident labels (originals are
+        stored [test, validation, train] concatenated)."""
+        if not self.original_labels:
+            return None
+        return self.slice_labels_by_class(self.original_labels.mem)
+
     def resplit_validation(self):
         """Moves a ratio of train samples into the validation class
         (reference: fullbatch.py:349 ``validation_ratio`` resplit)."""

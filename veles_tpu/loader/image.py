@@ -352,6 +352,10 @@ class StreamedFileImageLoader(StreamLoader, ImageDecoderMixin,
         # auto-label map — count from the materialized labels.
         return 1 + max(lab for _p, lab in self.files)
 
+    def dataset_labels(self):
+        return self.slice_labels_by_class(numpy.array(
+            [lab for _p, lab in self.files], dtype=numpy.int32))
+
     def materialize(self, index):
         path, label = self.files[index]
         arr = self.decode_image(path)

@@ -254,6 +254,10 @@ class StreamedImagenetLoader(StreamLoader):
         # Order: valid_data, valid_labels, train_data, train_labels.
         return out
 
+    def dataset_labels(self):
+        return [None, numpy.asarray(self._sources_[0][1]),
+                numpy.asarray(self._sources_[1][1])]
+
     def fill_rows(self, indices, out_data, out_labels):
         """Vectorized memmap reads (the 'decode' of the npy source)."""
         n_valid = self.class_lengths[VALID]
