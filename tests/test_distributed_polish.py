@@ -240,3 +240,81 @@ def test_precision_level_2_highest_matmul():
         assert wf.gather_results()["min_validation_err"] < 0.2
     finally:
         root.common.engine.precision_level = 0
+
+
+def test_coordinated_snapshot_defers_until_drained(tmp_path):
+    """Coordinated distributed snapshotting (reference:
+    snapshotter.py:181-195,227-234 — the master waits for all
+    workers' acks): a snapshot requested while jobs are in flight is
+    DEFERRED until the queue drains, and the resulting checkpoint
+    resumes training correctly."""
+    import pickle
+    from veles_tpu.snapshotter import SnapshotterToFile
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+    def build(seed=77):
+        prng.reset()
+        prng.get(0).seed(seed)
+        launcher = Launcher()
+        wf = MnistWorkflow(launcher, max_epochs=3, learning_rate=0.1,
+                           gradient_moment=0.5)
+        launcher.initialize()
+        return launcher, wf
+
+    _, master = build()
+    snap = SnapshotterToFile(master, directory=str(tmp_path),
+                             prefix="coord", time_interval=0.0,
+                             compression="")
+    snap.initialize()
+
+    # Master serves a job -> one outstanding worker job.
+    job = master.generate_data_for_slave("w1")
+    assert master.total_inflight_jobs() == 1
+
+    # Snapshot request mid-job: deferred, nothing written.
+    snap.run()
+    assert snap._deferred
+    assert snap.destination is None
+
+    # The worker answers; applying its update drains the queue and
+    # fires the deferred export.
+    _, worker = build()
+    replies = []
+    worker.do_job(job, None, replies.append)
+    master.apply_data_from_slave(replies[0], "w1")
+    assert master.total_inflight_jobs() == 0
+    assert not snap._deferred
+    assert snap.destination and os.path.isfile(snap.destination)
+
+    # The checkpoint is consistent: it resumes and finishes training.
+    with open(snap.destination, "rb") as fin:
+        resumed = pickle.load(fin)
+    l2 = Launcher()
+    l2.add_ref(resumed)
+    l2.initialize()
+    l2._finished.clear()
+    resumed.run()
+    assert resumed.decision.epoch_number == 3
+    assert resumed.gather_results()["min_validation_err"] < 0.2
+
+
+def test_drop_slave_fires_deferred_snapshot(tmp_path):
+    """A dropped worker requeues its jobs — that also counts as
+    draining, so a deferred snapshot must not hang forever."""
+    from veles_tpu.snapshotter import SnapshotterToFile
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+    prng.reset()
+    prng.get(0).seed(5)
+    launcher = Launcher()
+    master = MnistWorkflow(launcher, max_epochs=2)
+    launcher.initialize()
+    snap = SnapshotterToFile(master, directory=str(tmp_path),
+                             prefix="dropcoord", time_interval=0.0,
+                             compression="")
+    snap.initialize()
+    master.generate_data_for_slave("w9")
+    snap.run()
+    assert snap._deferred
+    master.drop_slave("w9")
+    assert not snap._deferred
+    assert snap.destination and os.path.isfile(snap.destination)

@@ -665,21 +665,42 @@ class AcceleratedWorkflow(Workflow):
                 return
         super(AcceleratedWorkflow, self).apply_data_from_slave(
             data, slave)
-        d = self.decision_unit
-        if d is None or meta is None:
+        try:
+            d = self.decision_unit
+            if d is None or meta is None:
+                return
+            cls = meta.get("minibatch_class")
+            epoch = meta.get("epoch_key")
+            key = (epoch, cls)
+            if metrics is not None and \
+                    hasattr(d, "accumulate_remote"):
+                d.accumulate_remote(cls, metrics, epoch)
+            if meta.get("last_minibatch"):
+                # Don't finish the class yet: other jobs from the
+                # same (epoch, class) may still be outstanding on
+                # other workers; finishing now would let their
+                # metrics leak into the next epoch's bucket.
+                self._finish_pending_[key] = bool(
+                    meta.get("epoch_ended"))
+            self._maybe_finish_remote(key)
+        finally:
+            # Always after the release above — a deferred snapshot
+            # must fire even for decision-less workflows.
+            self._notify_if_drained()
+
+    def total_inflight_jobs(self):
+        """Outstanding worker jobs (served, not yet answered or
+        requeued) — consulted by the snapshotter so checkpoints never
+        race in-flight updates."""
+        return sum(self._inflight_count_.values())
+
+    def _notify_if_drained(self):
+        if self._inflight_count_:
             return
-        cls = meta.get("minibatch_class")
-        epoch = meta.get("epoch_key")
-        key = (epoch, cls)
-        if metrics is not None and hasattr(d, "accumulate_remote"):
-            d.accumulate_remote(cls, metrics, epoch)
-        if meta.get("last_minibatch"):
-            # Don't finish the class yet: other jobs from the same
-            # (epoch, class) may still be outstanding on other
-            # workers; finishing now would let their metrics leak
-            # into the next epoch's bucket.
-            self._finish_pending_[key] = bool(meta.get("epoch_ended"))
-        self._maybe_finish_remote(key)
+        for unit in self.units:
+            drained = getattr(unit, "on_jobs_drained", None)
+            if drained is not None:
+                drained()
 
     def _release_inflight(self, slave, key):
         """Removes one tracked job for (slave, key) and decrements
@@ -737,3 +758,4 @@ class AcceleratedWorkflow(Workflow):
             if was_last:
                 self._finish_pending_.setdefault(key, epoch_ended)
             self._maybe_finish_remote(key)
+        self._notify_if_drained()

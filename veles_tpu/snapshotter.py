@@ -90,6 +90,7 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         self.destination = None
         self._counter = 0
         self._last_time = 0.0
+        self._deferred = False
 
     def initialize(self, **kwargs):
         super(SnapshotterBase, self).initialize(**kwargs)
@@ -105,7 +106,31 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         if time.time() - self._last_time < self.time_interval:
             return
         self._last_time = time.time()
+        # Coordinated distributed snapshot (reference:
+        # snapshotter.py:181-195,227-234 — the master waited for all
+        # slaves' acks): with worker jobs outstanding, the pickled
+        # state would disagree with updates already in flight, so
+        # defer until the workflow reports the queue drained
+        # (on_jobs_drained) or the jobs are requeued by a drop.
+        inflight = getattr(self.workflow, "total_inflight_jobs",
+                           None)
+        if inflight is not None and inflight():
+            self._deferred = True
+            self.info("deferring snapshot: %d worker job(s) in "
+                      "flight", inflight())
+            return
+        self._deferred = False  # self-heal a stale deferral
         self.export()
+
+    def on_jobs_drained(self):
+        """Master-side callback once every outstanding worker job has
+        been answered or requeued — performs a deferred snapshot."""
+        if self._deferred:
+            self._deferred = False
+            # Re-stamp: the throttle window starts at the actual
+            # export, not at the (earlier) deferred request.
+            self._last_time = time.time()
+            self.export()
 
     def export(self):
         raise NotImplementedError()
