@@ -3,6 +3,7 @@ interactive shell unit, frontend generator (reference capabilities:
 veles/forge/, veles/publishing/, veles/interaction.py,
 veles/scripts/generate_frontend.py)."""
 
+import threading
 import json
 import os
 
@@ -235,3 +236,164 @@ class TestForgeReviewRegressions:
         dest = tmp_path / "refetch"
         _, version = client.fetch("mnist-fc", str(dest))
         assert version == "v2"  # latest is still v2
+
+
+class _FakeConfluence(threading.Thread):
+    """Minimal in-memory Confluence REST endpoint (reference parity
+    target: veles/publishing/confluence.py against a real wiki)."""
+
+    def __init__(self):
+        super(_FakeConfluence, self).__init__(daemon=True)
+        import http.server
+        outer = self
+        self.pages = {}        # title -> {id, version, body, parent}
+        self.attachments = {}  # page_id -> {filename: bytes}
+        self.auth_seen = []
+        self._next_id = 1000
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, payload):
+                blob = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                from urllib.parse import urlparse, parse_qs
+                outer.auth_seen.append(
+                    self.headers.get("Authorization"))
+                q = parse_qs(urlparse(self.path).query)
+                if "/child/attachment" in self.path:
+                    page_id = self.path.split("/")[4]
+                    fname = q.get("filename", [""])[0]
+                    if fname in outer.attachments.get(page_id, {}):
+                        self._reply(200, {"results": [
+                            {"id": "att-%s-%s" % (page_id, fname)}]})
+                    else:
+                        self._reply(200, {"results": []})
+                    return
+                title = q.get("title", [""])[0]
+                page = outer.pages.get(title)
+                if page is None:
+                    self._reply(200, {"results": []})
+                else:
+                    self._reply(200, {"results": [{
+                        "id": page["id"],
+                        "version": {"number": page["version"]}}]})
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                if "/child/attachment" in self.path:
+                    page_id = self.path.split("/")[4]
+                    fname = body.split(b'filename="')[1] \
+                        .split(b'"')[0].decode()
+                    payload = body.split(b"\r\n\r\n", 1)[1] \
+                        .rsplit(b"\r\n--", 1)[0]
+                    existing = outer.attachments.get(page_id, {})
+                    if self.path.endswith("/data"):
+                        # Update endpoint: replace existing bytes.
+                        existing[fname] = payload
+                    elif fname in existing:
+                        # Real Confluence rejects duplicate names on
+                        # the create endpoint.
+                        self._reply(400, {"message":
+                                          "duplicate filename"})
+                        return
+                    else:
+                        outer.attachments.setdefault(
+                            page_id, {})[fname] = payload
+                    self._reply(200, {})
+                    return
+                data = json.loads(body)
+                pid = str(outer._next_id)
+                outer._next_id += 1
+                outer.pages[data["title"]] = {
+                    "id": pid, "version": 1,
+                    "body": data["body"]["storage"]["value"],
+                    "parent": (data.get("ancestors") or
+                               [{"id": None}])[0]["id"]}
+                self._reply(200, {"id": pid})
+
+            def do_PUT(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0)))
+                data = json.loads(body)
+                page = outer.pages[data["title"]]
+                page["version"] = data["version"]["number"]
+                page["body"] = data["body"]["storage"]["value"]
+                self._reply(200, {"id": page["id"]})
+
+        self.httpd = http.server.HTTPServer(("127.0.0.1", 0),
+                                            Handler)
+        self.port = self.httpd.server_address[1]
+
+    def run(self):
+        self.httpd.serve_forever()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_publisher_confluence_backend(tmp_path):
+    """Confluence publishing (reference: publishing/confluence.py):
+    page create under a parent, version bump on re-publish, plot
+    attachments, basic auth."""
+    from veles_tpu.config import root
+    from veles_tpu.plotting_units import AccumulatingPlotter
+    from veles_tpu.publishing import Publisher
+    from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+    server = _FakeConfluence()
+    server.start()
+    try:
+        # Pre-existing parent page.
+        server.pages["Experiments"] = {"id": "7", "version": 3,
+                                       "body": "", "parent": None}
+        cfg = root.common.publishing.confluence
+        cfg.server = "http://127.0.0.1:%d" % server.port
+        cfg.username = "bot"
+        cfg.password = "token123"
+        cfg.space = "ML"
+        cfg.parent = "Experiments"
+        cfg.page = None
+        prng.reset()
+        prng.get(0).seed(1234)
+        launcher = Launcher()
+        wf = MnistWorkflow(launcher, max_epochs=2, learning_rate=0.1)
+        plot = AccumulatingPlotter(wf, name="val err",
+                                   input=wf.decision,
+                                   input_field="min_validation_err")
+        plot.link_from(wf.decision)
+        pub = Publisher(wf, backends=("confluence",),
+                        output_dir=str(tmp_path))
+        pub.link_from(wf.decision)
+        pub.gate_block = ~wf.decision.complete
+        launcher.initialize()
+        launcher.run()
+
+        assert len(pub.outputs) == 1
+        page = server.pages["MnistWorkflow"]
+        assert page["parent"] == "7"
+        assert "min_validation_err" in page["body"]
+        assert 'ri:filename="plot_0.png"' in page["body"]
+        atts = server.attachments[page["id"]]
+        assert atts["plot_0.png"].startswith(b"\x89PNG")
+        assert pub.outputs[0].endswith("/pages/%s" % page["id"])
+        import base64
+        expected = "Basic " + base64.b64encode(
+            b"bot:token123").decode()
+        assert expected in server.auth_seen
+
+        # Re-publish: same page, bumped version.
+        pub.run()
+        assert server.pages["MnistWorkflow"]["version"] == 2
+    finally:
+        server.stop()
+        root.common.publishing.reset()

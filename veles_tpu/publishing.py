@@ -7,10 +7,9 @@ pdf_backend.py:48, confluence_backend.py, jinja2_template_backend
 .py:64): the :class:`Publisher` unit collects name/config/results/
 unit-stats/plot images/graph DOT and renders through a backend
 registry — Markdown (report.md + PNGs), HTML (self-contained page,
-images inlined base64), PDF (matplotlib PdfPages).  A Confluence
-backend would POST the HTML body to the wiki REST API; it is omitted
-here because this environment has no network egress — the HTML
-backend produces the same body.
+images inlined base64), PDF (matplotlib PdfPages), and Confluence
+(wiki page + attachments over the REST API; see
+publishing_confluence.py).
 """
 
 import base64
@@ -158,7 +157,9 @@ class Publisher(Unit):
 
     kwargs: ``backends`` — names from the registry (default
     ("markdown",)); ``output_dir``; ``include_config`` — embed the
-    effective config tree.
+    effective config tree; ``backend_config`` — {backend name:
+    constructor kwargs} (e.g. the confluence server/space; backends
+    otherwise read their root.common.publishing.* config).
     """
 
     def __init__(self, workflow, **kwargs):
@@ -167,6 +168,7 @@ class Publisher(Unit):
         self.backends = tuple(kwargs.get("backends", ("markdown",)))
         self.output_dir = kwargs.get("output_dir", "report")
         self.include_config = kwargs.get("include_config", True)
+        self.backend_config = dict(kwargs.get("backend_config") or {})
         self.outputs = []
 
     def gather_report(self):
@@ -203,7 +205,12 @@ class Publisher(Unit):
         report = self.gather_report()
         self.outputs = []
         for name in self.backends:
-            backend = BackendRegistry.registry[name]()
+            backend = BackendRegistry.registry[name](
+                **self.backend_config.get(name, {}))
             path = backend.render(report, self.output_dir)
             self.outputs.append(path)
             self.info("published %s report -> %s", name, path)
+
+# Import side-effect registration of the network backend (kept in its
+# own module so the core publisher stays dependency-light).
+from . import publishing_confluence  # noqa: E402,F401
