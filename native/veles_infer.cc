@@ -100,7 +100,7 @@ enum class Act { kLinear, kTanh, kSoftplus, kStr, kSigmoid, kSoftmax };
 
 Act act_of(const std::string &type) {
   if (type == "all2all_tanh" || type == "conv_tanh" ||
-      type == "activation_tanh")
+      type == "activation_tanh" || type == "all2all_deconv_tanh")
     return Act::kTanh;
   if (type == "all2all_relu" || type == "conv_relu" ||
       type == "activation_relu")
@@ -109,7 +109,8 @@ Act act_of(const std::string &type) {
       type == "activation_str")
     return Act::kStr;
   if (type == "all2all_sigmoid" || type == "conv_sigmoid" ||
-      type == "activation_sigmoid")
+      type == "activation_sigmoid" ||
+      type == "all2all_deconv_sigmoid" || type == "rbm")
     return Act::kSigmoid;
   if (type == "softmax") return Act::kSoftmax;
   return Act::kLinear;
@@ -276,6 +277,26 @@ void run_lrn(const UnitDesc &u, const float *in, float *out,
   }
 }
 
+/* Kohonen forward: squared distance to every SOM neuron, weights
+ * stored (n_neurons, n_in) row-major (KohonenForward.distances). */
+void run_kohonen(const UnitDesc &u, const float *in, float *out,
+                 int batch, int fan_in, int n_out) {
+  const Param &w = u.params.at("weights");
+  for (int s = 0; s < batch; ++s) {
+    const float *x = in + s * fan_in;
+    float *y = out + s * n_out;
+    for (int j = 0; j < n_out; ++j) {
+      const float *wr = w.data.data() + (size_t)j * fan_in;
+      double d = 0.0;
+      for (int i = 0; i < fan_in; ++i) {
+        const double t = (double)x[i] - wr[i];
+        d += t * t;
+      }
+      y[j] = (float)d;
+    }
+  }
+}
+
 void run_mean_disp(const UnitDesc &u, const float *in, float *out,
                    int batch, int sample) {
   const float *mean = u.params.at("mean").data.data();
@@ -323,7 +344,8 @@ bool infer_shapes(VtModel *m) {
     const Shape &si = m->shapes[i];
     Shape so = si;
     const std::string &t = u.type;
-    if (t.rfind("all2all", 0) == 0 || t == "softmax") {
+    if (t.rfind("all2all", 0) == 0 || t == "softmax" ||
+        t == "rbm") {
       const int n_out = (int)u.cfgv("n_out");
       if (n_out <= 0) {
         set_error("unit " + u.name + ": bad n_out");
@@ -332,6 +354,24 @@ bool infer_shapes(VtModel *m) {
       if (!checked_param(u, "weights", (size_t)si.size() * n_out) ||
           !check_optional_bias(u, (size_t)n_out))
         return false;
+      so = Shape{1, 1, n_out, false};
+    } else if (t == "kohonen") {
+      const int n_out = (int)u.cfgv("n_out");
+      if (n_out <= 0) {
+        set_error("unit " + u.name + ": bad n_out");
+        return false;
+      }
+      /* run_kohonen walks rows of length si.size(): dims must agree
+       * with the propagated activation, not just the element count. */
+      auto wit = u.params.find("weights");
+      if (!checked_param(u, "weights", (size_t)si.size() * n_out) ||
+          wit->second.dims.size() != 2 ||
+          (int)wit->second.dims[0] != n_out ||
+          (int)wit->second.dims[1] != si.size()) {
+        set_error("unit " + u.name + ": kohonen weights must be "
+                  "(n_neurons, n_in)");
+        return false;
+      }
       so = Shape{1, 1, n_out, false};
     } else if (t.rfind("conv", 0) == 0) {
       auto wit = u.params.find("weights");
@@ -603,8 +643,12 @@ int vt_forward(const VtModel *m, const float *input, int batch,
     const Shape &so = m->shapes[i + 1];
     b.assign((size_t)batch * so.size(), 0.0f);
     const std::string &t = u.type;
-    if (t.rfind("all2all", 0) == 0 || t == "softmax") {
+    if (t.rfind("all2all", 0) == 0 || t == "softmax" ||
+        t == "rbm") {
       run_dense(u, a.data(), b.data(), batch, si.size(), so.size());
+    } else if (t == "kohonen") {
+      run_kohonen(u, a.data(), b.data(), batch, si.size(),
+                  so.size());
     } else if (t.rfind("conv", 0) == 0) {
       run_conv(u, a.data(), b.data(), batch, si, so);
     } else if (t.find("pooling") != std::string::npos) {

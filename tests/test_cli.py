@@ -6,6 +6,7 @@ import os
 import subprocess
 import sys
 
+import numpy
 import pytest
 
 from veles_tpu.__main__ import Main, import_workflow_module, \
@@ -127,3 +128,43 @@ def test_frontend_flag_generates_wizard(tmp_path):
     assert rc == 0
     page = out.read_text()
     assert "--optimize" in page and "compose()" in page
+
+
+def test_run_flags_stray_numpy_random(tmp_path):
+    """A workflow unit calling global numpy.random during a CLI run
+    fails loudly instead of silently breaking reproducibility
+    (reference: prng/random_generator.py:49-61)."""
+    wf = tmp_path / "stray_random.py"
+    wf.write_text('''
+import numpy
+from veles_tpu.units import Unit, IUnit
+from veles_tpu.workflow import Workflow
+
+
+class StrayRandomUnit(Unit):
+    def run(self):
+        numpy.random.rand(3)  # the banned global draw
+
+
+class StrayWorkflow(Workflow):
+    def __init__(self, workflow, **kwargs):
+        super(StrayWorkflow, self).__init__(workflow, **kwargs)
+        self.stray = StrayRandomUnit(self)
+        self.stray.link_from(self.start_point)
+        self.end_point.link_from(self.stray)
+
+
+def run(load, main):
+    load(StrayWorkflow)
+    main()
+''')
+    rc = run_main([str(wf), "-v", "error"])
+    assert rc != 0  # the guard turned the stray draw into a failure
+    # The guard must not leak past the run.
+    numpy.random.rand(1)
+    # Causality: the same workflow passes with the guard disabled.
+    rc = run_main([str(wf), "-v", "error",
+                   "root.common.engine.poison_numpy_random=False"])
+    assert rc == 0
+    from veles_tpu.config import root
+    root.common.engine.poison_numpy_random = True

@@ -83,3 +83,42 @@ def test_seed_none_is_entropy():
     g.seed(None)
     b = g.uniform(size=4)
     assert not numpy.array_equal(a, b)
+
+
+def test_poison_numpy_random_guard():
+    """While poisoned, hidden-global-state sampling raises loudly;
+    explicitly seeded generators stay usable; unpoisoned() restores
+    (reference: prng/random_generator.py:49-61)."""
+    import pytest
+    prng.poison_numpy_random()
+    try:
+        with pytest.raises(AttributeError, match="reproducibility"):
+            numpy.random.rand(3)
+        with pytest.raises(AttributeError):
+            numpy.random.seed(0)
+        # Seeded constructions are reproducible by definition — allowed.
+        rs = numpy.random.RandomState(7)
+        assert rs.rand(2).shape == (2,)
+        gen = numpy.random.default_rng(7)
+        assert gen.random(2).shape == (2,)
+        # Our own generators must keep working under the guard.
+        g = prng.get(0)
+        g.seed(11)
+        assert g.uniform(size=3).shape == (3,)
+        with prng.unpoisoned():
+            numpy.random.rand(1)  # temporarily legal
+        with pytest.raises(AttributeError):
+            numpy.random.rand(1)  # re-poisoned on exit
+    finally:
+        prng.unpoison_numpy_random()
+    numpy.random.rand(1)  # fully restored
+
+
+def test_poison_is_idempotent():
+    prng.poison_numpy_random()
+    prng.poison_numpy_random()
+    try:
+        rs = numpy.random.RandomState(1)
+        assert rs is not None
+    finally:
+        prng.unpoison_numpy_random()

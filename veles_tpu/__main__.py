@@ -377,14 +377,22 @@ class Main(Logger, CommandLineBase):
             self.module = import_workflow_module(self.args.workflow)
             if self.args.dump_config:
                 root.print_()
-            if self.args.optimize:
-                self.run_genetics()
-            elif self.args.ensemble_train:
-                self.run_ensemble_train()
-            elif self.args.ensemble_test:
-                self.run_ensemble_test()
-            else:
-                self.run_regular()
+            guard = bool(root.common.engine.get(
+                "poison_numpy_random", True))
+            if guard:
+                prng.poison_numpy_random()
+            try:
+                if self.args.optimize:
+                    self.run_genetics()
+                elif self.args.ensemble_train:
+                    self.run_ensemble_train()
+                elif self.args.ensemble_test:
+                    self.run_ensemble_test()
+                else:
+                    self.run_regular()
+            finally:
+                if guard:
+                    prng.unpoison_numpy_random()
         except KeyboardInterrupt:
             self.warning("interrupted")
             if self.launcher is not None:

@@ -169,6 +169,10 @@ root.common.update({
         "precision_level": 0,
         "mesh_axes": {"data": "data", "model": "model"},
         "sync_run": False,
+        # Reproducibility guard: replace numpy.random's module-level
+        # sampling functions with a loud error while a CLI run is live
+        # (reference: prng/random_generator.py:49-61).
+        "poison_numpy_random": True,
     },
     "loader": {
         "shuffle_limit": -1,

@@ -53,6 +53,13 @@ EXPORTABLE = {
     "mean_disp": (),
     "activation_tanh": (), "activation_relu": (),
     "activation_str": (), "activation_sigmoid": (),
+    # Long tail (reference unit_factory.cc registers every forward
+    # type): RBM inference = sigmoid dense over the CD-trained
+    # weights; tied-weight deconv decoders; Kohonen BMU distances.
+    "rbm": (),
+    "all2all_deconv": (), "all2all_deconv_sigmoid": (),
+    "all2all_deconv_tanh": (),
+    "kohonen": (),
 }
 
 TANH_A, TANH_B = 1.7159, 0.6666
@@ -84,6 +91,38 @@ def _unit_entry(unit):
             vec.map_read()
             params[pname] = numpy.asarray(
                 vec.mem, dtype=numpy.float32)
+    elif mapping == "rbm":
+        # Inference forward is h = sigmoid(v·W + c): the visible bias
+        # only matters for the training-time Gibbs chain, so the
+        # artifact carries weights + hidden bias and rides the dense
+        # execution path (reference libVeles executes every unit as a
+        # forward-only chain, unit.h:41).
+        unit.weights.map_read()
+        params["weights"] = numpy.asarray(unit.weights.mem,
+                                          dtype=numpy.float32)
+        if unit.include_bias and unit.bias:
+            unit.bias.map_read()
+            params["bias"] = numpy.asarray(unit.bias.mem,
+                                           dtype=numpy.float32)
+    elif mapping.startswith("all2all_deconv"):
+        # Tied weights live on the paired encoder; the standalone
+        # artifact materializes them transposed so the decoder is an
+        # ordinary dense unit (y = x·Wᵀ + b  →  x·(Wᵀ) with W stored
+        # pre-transposed) for every runtime.
+        enc_w = unit.encoder.weights
+        enc_w.map_read()
+        w = numpy.asarray(enc_w.mem, dtype=numpy.float32)
+        params["weights"] = numpy.ascontiguousarray(w.T)
+        if unit.include_bias and unit.vbias:
+            unit.vbias.map_read()
+            params["bias"] = numpy.asarray(unit.vbias.mem,
+                                           dtype=numpy.float32)
+        config["output_sample_shape"] = [int(w.shape[0])]
+    elif mapping == "kohonen":
+        unit.weights.map_read()
+        params["weights"] = numpy.asarray(unit.weights.mem,
+                                          dtype=numpy.float32)
+        config["output_sample_shape"] = [int(unit.n_neurons)]
     else:
         for pname, vec in getattr(unit, "trainables", {}).items():
             if not vec:
@@ -263,20 +302,18 @@ class ExportedModel(object):
             return x
         if t.startswith("activation_"):
             return _ACTS[t.split("activation_")[1]](x)
-        if t.startswith("all2all") or t == "softmax":
+        if t.startswith("all2all") or t in ("softmax", "rbm"):
             w = self._param(entry, "weights")
             y = x.reshape(x.shape[0], -1) @ w
             if "bias" in entry["params"]:
                 y = y + self._param(entry, "bias")
-            act = {"all2all": "linear", "all2all_tanh": "tanh",
-                   "all2all_relu": "softplus",
-                   "all2all_str": "str", "all2all_sigmoid": "sigmoid",
-                   "softmax": "softmax"}[t]
-            y = _ACTS[act](y)
+            y = _ACTS[_DENSE_ACT[t]](y)
             shape = cfg.get("output_sample_shape")
             if shape:
                 y = y.reshape((x.shape[0],) + tuple(shape))
             return y
+        if t == "kohonen":
+            return self._kohonen_numpy(entry, x)
         if t.startswith("conv"):
             return self._conv_numpy(entry, x)
         if t.endswith("pooling"):
@@ -284,6 +321,18 @@ class ExportedModel(object):
         if t == "norm":
             return self._lrn_numpy(cfg, x)
         raise Bug("unknown unit type %r in artifact" % t)
+
+    def _kohonen_numpy(self, entry, x):
+        # Squared distance to each SOM neuron (KohonenForward emits
+        # the full distance map; BMU = argmin over the last axis).
+        # float64 accumulation: the expanded form cancels near zero
+        # exactly where the SOM converged, and the native runtime
+        # accumulates exact squared differences in double.
+        w = self._param(entry, "weights") \
+            .astype(numpy.float64)  # (n_neurons, n_in)
+        xf = x.reshape(x.shape[0], -1).astype(numpy.float64)
+        return ((xf * xf).sum(1, keepdims=True) - 2.0 * (xf @ w.T) +
+                (w * w).sum(1)).astype(numpy.float32)
 
     def _conv_numpy(self, entry, x):
         cfg = entry["config"]
@@ -401,20 +450,25 @@ class ExportedModel(object):
                 pass
             elif t.startswith("activation_"):
                 x = _jax_act(t.split("activation_")[1], x)
-            elif t.startswith("all2all") or t == "softmax":
+            elif t.startswith("all2all") or t in ("softmax", "rbm"):
                 w = self._param(entry, "weights")
                 y = x.reshape(x.shape[0], -1) @ w
                 if "bias" in entry["params"]:
                     y = y + self._param(entry, "bias")
-                act = {"all2all": "linear", "all2all_tanh": "tanh",
-                       "all2all_relu": "softplus",
-                       "all2all_str": "str",
-                       "all2all_sigmoid": "sigmoid",
-                       "softmax": "softmax"}[t]
-                x = _jax_act(act, y)
+                x = _jax_act(_DENSE_ACT[t], y)
                 shape = cfg.get("output_sample_shape")
                 if shape:
                     x = x.reshape((x.shape[0],) + tuple(shape))
+            elif t == "kohonen":
+                w = self._param(entry, "weights")
+                xf = x.reshape(x.shape[0], -1)
+                # Expanded ‖x−w‖² cancels catastrophically under the
+                # TPU's default bf16-input matmul — distances sit near
+                # zero exactly where the SOM converged. Force full f32.
+                xw = lax.dot(xf, w.T,
+                             precision=jax.lax.Precision.HIGHEST)
+                x = ((xf * xf).sum(1, keepdims=True) - 2.0 * xw +
+                     (w * w).sum(1))
             elif t.startswith("conv"):
                 w = self._param(entry, "weights")
                 y = lax.conv_general_dilated(
@@ -478,6 +532,19 @@ class ExportedModel(object):
 def _np_softmax(v):
     e = numpy.exp(v - v.max(axis=-1, keepdims=True))
     return e / e.sum(axis=-1, keepdims=True)
+
+
+#: Activation per dense-family unit type (shared by the numpy mirror
+#: and the jax serving chain).
+_DENSE_ACT = {
+    "all2all": "linear", "all2all_tanh": "tanh",
+    "all2all_relu": "softplus", "all2all_str": "str",
+    "all2all_sigmoid": "sigmoid", "softmax": "softmax",
+    "rbm": "sigmoid",
+    "all2all_deconv": "linear",
+    "all2all_deconv_sigmoid": "sigmoid",
+    "all2all_deconv_tanh": "tanh",
+}
 
 
 _ACTS = {

@@ -19,9 +19,14 @@ identical randomness — same guarantee the reference makes by pickling
 its generator state.
 """
 
+import contextlib
+
 import numpy
 
 from .logger import Logger
+
+#: The real numpy.random module — internal use survives poisoning.
+_np_random = numpy.random
 
 
 class RandomGenerator(Logger):
@@ -32,7 +37,7 @@ class RandomGenerator(Logger):
         super(RandomGenerator, self).__init__()
         self.key = key
         self._seed = None
-        self._state = numpy.random.RandomState()
+        self._state = _np_random.RandomState()
         self._jax_key = None
         self.seed(numpy.frombuffer(b"seed" + bytes([key & 0xFF]),
                                    dtype=numpy.uint8))
@@ -64,11 +69,11 @@ class RandomGenerator(Logger):
                 numpy.bitwise_xor.reduce(
                     seed.view(numpy.uint8).astype(numpy.uint32) *
                     numpy.arange(1, seed.nbytes + 1, dtype=numpy.uint32)))
-            self._state = numpy.random.RandomState(
+            self._state = _np_random.RandomState(
                 seed.view(numpy.uint8).astype(numpy.uint32))
             jseed = int(mixed)
         else:
-            self._state = numpy.random.RandomState(seed)
+            self._state = _np_random.RandomState(seed)
             jseed = int(seed) & 0xFFFFFFFF
         # Lazily materialize the jax key — jax may not be importable at
         # seed time in pure-host tooling contexts.
@@ -150,7 +155,7 @@ class RandomGenerator(Logger):
         super(RandomGenerator, self).__init__()
         self.key = state["key"]
         self._seed = state["seed"]
-        self._state = numpy.random.RandomState()
+        self._state = _np_random.RandomState()
         self._state.set_state(state["np_state"])
         self._jax_seed = state["jax_seed"]
         if state["jax_key"] is not None:
@@ -175,3 +180,94 @@ def get(key=0):
 
 def reset():
     _generators.clear()
+
+
+# -- numpy.random poisoning (reproducibility guard) ---------------------
+#
+# The reference forbids direct global numpy.random use so a stray
+# ``numpy.random.rand()`` can't silently break run reproducibility
+# (reference: prng/random_generator.py:49-61 ``WrappedRandom``).  The
+# TPU build keeps the guard but allows the *seeded-generator classes*
+# (RandomState/Generator/default_rng & friends): an explicitly seeded
+# generator is reproducible by construction — only the module-level
+# sampling functions, which draw from hidden global state, are banned.
+
+#: Attributes that stay reachable while poisoned: constructing an
+#: explicitly seeded generator is reproducible; the hidden-global-state
+#: module functions are not.
+_POISON_ALLOWED = frozenset((
+    "RandomState", "Generator", "default_rng", "BitGenerator",
+    "SeedSequence", "MT19937", "PCG64", "PCG64DXSM", "Philox",
+    "SFC64",
+    # scipy reads numpy.random.mtrand._rand at import time to wire its
+    # own default_rng plumbing; banning the submodule attr would make
+    # `import scipy.stats` explode. Stray *sampling* calls
+    # (numpy.random.rand/seed/...) are what the guard is for.
+    "mtrand",
+))
+
+
+class _PoisonedRandom(object):
+    """Stand-in installed over ``numpy.random`` while a run is live."""
+
+    def __init__(self, real):
+        object.__setattr__(self, "_real", real)
+
+    def __getattr__(self, item):
+        if item in _POISON_ALLOWED or item.startswith("__"):
+            return getattr(object.__getattribute__(self, "_real"),
+                           item)
+        # The guard targets user/framework code: third-party internals
+        # (e.g. jax's k8s retry jitter, scipy import plumbing) draw
+        # from numpy.random legitimately and are outside the
+        # reproducibility contract — let their calls through.  A draw
+        # the user *routes through* such a library (scipy rvs with no
+        # random_state) also escapes; the guard is a tripwire for
+        # direct stray use, not a sandbox.  veles_tpu frames never
+        # qualify, even from an installed (site-packages) copy.
+        import sys as _sys
+        frame = _sys._getframe(1)
+        caller = frame.f_code.co_filename
+        if ("site-packages" in caller or "dist-packages" in caller) \
+                and ("veles_tpu" not in caller):
+            return getattr(object.__getattribute__(self, "_real"),
+                           item)
+        raise AttributeError(
+            "veles_tpu.prng forbids direct numpy.random.%s during a "
+            "run — it draws from hidden global state and breaks "
+            "reproducibility. Use prng.get().%s / unit.rand().%s, an "
+            "explicitly seeded numpy.random.RandomState, or wrap "
+            "third-party code in prng.unpoisoned()." %
+            (item, item, item))
+
+
+def poison_numpy_random():
+    """Installs the guard (idempotent).  Covers both access routes:
+    ``numpy.random.rand(...)`` (package attribute) and
+    ``from numpy.random import rand`` (sys.modules lookup).  A ref
+    imported *before* poisoning can't be revoked — same limitation as
+    the reference guard."""
+    import sys as _sys
+    if not isinstance(numpy.random, _PoisonedRandom):
+        poisoned = _PoisonedRandom(_np_random)
+        numpy.random = poisoned
+        _sys.modules["numpy.random"] = poisoned
+
+
+def unpoison_numpy_random():
+    import sys as _sys
+    numpy.random = _np_random
+    _sys.modules["numpy.random"] = _np_random
+
+
+@contextlib.contextmanager
+def unpoisoned():
+    """Temporarily restores the real module for third-party code that
+    legitimately touches numpy.random internals."""
+    was = isinstance(numpy.random, _PoisonedRandom)
+    unpoison_numpy_random()
+    try:
+        yield
+    finally:
+        if was:
+            poison_numpy_random()

@@ -66,6 +66,7 @@ class MnistRBMWorkflow(AcceleratedWorkflow):
         self.repeater.gate_block = self.decision.complete
         self.end_point.link_from(self.gd)
         self.end_point.gate_block = ~self.decision.complete
+        self.forwards = [self.rbm]
 
 
 class MnistAEWorkflow(AcceleratedWorkflow):
@@ -118,3 +119,4 @@ class MnistAEWorkflow(AcceleratedWorkflow):
         self.repeater.gate_block = self.decision.complete
         self.end_point.link_from(self.gd_encoder)
         self.end_point.gate_block = ~self.decision.complete
+        self.forwards = [self.encoder, self.decoder]
