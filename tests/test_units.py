@@ -219,3 +219,58 @@ def test_znicz_mapped_registries():
     assert ForwardUnitRegistry.registry["all2all_tanh"] is All2AllTanh
     assert gd_for(All2AllTanh) is GDTanh
     assert gd_for("softmax").__name__ == "GDSoftmax"
+
+
+def test_run_after_stop_warns_and_raises(caplog):
+    """A unit fired after stop() is a control-flow-link error: warn by
+    default, raise under root.common.exceptions.run_after_stop
+    (reference: units.py:793-819)."""
+    import logging
+    from veles_tpu.config import root
+    from veles_tpu.error import RunAfterStopError
+
+    trace = []
+    wf = DummyWorkflow()
+    u = Recorder(wf, trace, name="late")
+    u.link_from(wf.start_point)
+    u.initialize()
+    wf.stop()
+    with caplog.at_level(logging.WARNING):
+        u.check_gate_and_run(wf.start_point)
+    assert trace == []  # the run was suppressed
+    assert any("after stop()" in r.message for r in caplog.records)
+
+    root.common.exceptions.run_after_stop = True
+    try:
+        with pytest.raises(RunAfterStopError):
+            u.check_gate_and_run(wf.start_point)
+    finally:
+        root.common.exceptions.run_after_stop = False
+
+
+def test_sniffed_lock_reports_suspected_deadlock(caplog):
+    """Lock acquisitions stuck past the deadline announce themselves
+    (reference: distributable.py:139-157 DEADLOCK_TIME)."""
+    import logging
+    import threading
+    import time
+    from veles_tpu.distributable import SniffedLock
+
+    lock = SniffedLock(name="probe", deadline=0.05)
+    lock.acquire()
+    got = []
+
+    def contender():
+        with caplog.at_level(logging.WARNING):
+            lock.acquire()
+        got.append(True)
+        lock.release()
+
+    t = threading.Thread(target=contender)
+    t.start()
+    time.sleep(0.2)          # let the deadline pass while held
+    lock.release()
+    t.join(timeout=5)
+    assert got == [True]     # acquisition still succeeded after warn
+    assert any("possible deadlock" in r.message
+               for r in caplog.records)

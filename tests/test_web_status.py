@@ -150,3 +150,64 @@ def test_post_token_enforcement():
         assert "m1" in srv.status()
     finally:
         srv.stop()
+
+
+TINY_PNG = (  # 1x1 transparent PNG
+    b"\x89PNG\r\n\x1a\n\x00\x00\x00\rIHDR\x00\x00\x00\x01\x00\x00"
+    b"\x00\x01\x08\x06\x00\x00\x00\x1f\x15\xc4\x89\x00\x00\x00\n"
+    b"IDATx\x9cc\x00\x01\x00\x00\x05\x00\x01\r\n-\xb4\x00\x00\x00"
+    b"\x00IEND\xaeB`\x82")
+
+
+def test_dashboard_renders_graph_and_plots(status_server):
+    """Heartbeats carrying the DOT graph and plot PNGs surface on the
+    dashboard (reference: web_status.py:113-243 graph + plot links);
+    non-PNG blobs and script-laden DOT text are neutralized."""
+    import base64
+    _post(status_server.port, "/update", {
+        "id": "m2", "workflow": "AlexNet", "mode": "standalone",
+        "graph": 'digraph G { a [label="<script>evil()</script>"]; '
+                 "a -> b; }",
+        "plots": {
+            "train_err": base64.b64encode(TINY_PNG).decode(),
+            "evil": base64.b64encode(
+                b"<script>alert(1)</script>").decode(),
+            "junk": "%%%not-base64%%%",
+        },
+    })
+    page = _get(status_server.port, "/")
+    assert "workflow graph (DOT)" in page
+    assert "a -&gt; b" in page                   # DOT source, escaped
+    assert "<script>evil()" not in page
+    assert "data:image/png;base64," in page      # the real PNG
+    assert "train_err" in page
+    assert base64.b64encode(
+        b"<script>alert(1)</script>").decode() not in page
+    assert "alert(1)" not in page
+
+
+def test_launcher_payload_carries_graph_and_plots(tmp_path):
+    """status_payload ships the workflow DOT once computed, and the
+    newest PNGs from the plots directory within the byte budget."""
+    from veles_tpu.config import root
+    from veles_tpu.dummy import DummyWorkflow
+    prng.reset()
+    launcher = Launcher()
+    wf = DummyWorkflow()
+    launcher.workflow = wf
+
+    plots = tmp_path / "plots"
+    plots.mkdir()
+    (plots / "err.png").write_bytes(TINY_PNG)
+    (plots / "huge.png").write_bytes(b"\x89PNG\r\n\x1a\n" +
+                                     b"0" * (Launcher.PLOT_BYTES_MAX + 1))
+    old = root.common.dirs.get("plots")
+    root.common.dirs.plots = str(plots)
+    try:
+        payload = launcher.status_payload("mid/1")
+    finally:
+        root.common.dirs.plots = old
+    assert payload["graph"].startswith("digraph")
+    assert "start" in payload["graph"].lower() or \
+        "u0" in payload["graph"]
+    assert list(payload["plots"]) == ["err"]  # budget enforced

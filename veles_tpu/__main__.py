@@ -380,6 +380,8 @@ class Main(Logger, CommandLineBase):
             guard = bool(root.common.engine.get(
                 "poison_numpy_random", True))
             if guard:
+                prng.guard_path(os.path.dirname(os.path.abspath(
+                    self.args.workflow)))
                 prng.poison_numpy_random()
             try:
                 if self.args.optimize:

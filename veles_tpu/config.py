@@ -156,6 +156,7 @@ root.common.update({
         "snapshots": os.path.join(
             os.path.expanduser("~"), ".veles_tpu/snapshots"),
         "events": os.path.join(os.path.expanduser("~"), ".veles_tpu/events"),
+        "plots": os.path.join(os.path.expanduser("~"), ".veles_tpu/plots"),
     },
     "engine": {
         # "tpu", "cpu", or "auto" — resolved by backends.Device.

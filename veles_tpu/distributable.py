@@ -12,6 +12,7 @@ joins, what it re-applies on elastic reconfiguration, and what it does
 when a worker is dropped.
 """
 
+import logging
 import threading
 
 from .logger import Logger
@@ -19,6 +20,48 @@ from .logger import Logger
 #: Seconds after which a lock acquisition is logged as a suspected
 #: deadlock (reference: distributable.py:139-157, DEADLOCK_TIME=4).
 DEADLOCK_TIME = 4.0
+
+
+class SniffedLock(object):
+    """A lock whose acquisition sniffs for deadlocks: if it cannot be
+    taken within ``deadline`` seconds a warning names the lock and the
+    blocked call site, then acquisition blocks normally (reference:
+    distributable.py:139-157 ``_data_threadsafe``).  High-confusion-
+    cost bugs in a threaded control plane announce themselves instead
+    of hanging silently."""
+
+    def __init__(self, name="lock", deadline=DEADLOCK_TIME,
+                 logger=None):
+        self._lock = threading.Lock()
+        self.name = name
+        self.deadline = deadline
+        self._log = logger or logging.getLogger("SniffedLock")
+
+    def acquire(self, blocking=True, timeout=-1):
+        if not blocking or 0 <= timeout <= self.deadline:
+            return self._lock.acquire(blocking, timeout)
+        if self._lock.acquire(timeout=self.deadline):
+            return True
+        self._log.warning(
+            "possible deadlock: %r not acquired after %.1fs "
+            "(holder still running?); continuing to wait",
+            self.name, self.deadline)
+        if timeout < 0:
+            return self._lock.acquire()
+        return self._lock.acquire(timeout=timeout - self.deadline)
+
+    def release(self):
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
 
 
 class Pickleable(Logger):
@@ -60,9 +103,16 @@ class Distributable(Pickleable):
 
     def init_unpickled(self):
         super(Distributable, self).init_unpickled()
-        self._data_lock_ = threading.Lock()
+        self._data_lock_ = SniffedLock(
+            name="%s.data_lock" % type(self).__name__)
         self._data_event_ = threading.Event()
         self._data_event_.set()
+
+    def data_threadsafe(self):
+        """The unit's data lock as a context manager — guards
+        generate/apply state against the control-plane threads, with
+        deadlock sniffing (reference: distributable.py:139-157)."""
+        return self._data_lock_
 
     @property
     def has_data_for_slave(self):

@@ -30,5 +30,10 @@ class MasterSlaveCommunicationError(VelesError):
     """Control-plane communication failure between coordinator and workers."""
 
 
+class RunAfterStopError(VelesError):
+    """A unit's run() fired after stop() — a control-flow-link error
+    (reference: units.py:793-819)."""
+
+
 class DeviceNotFoundError(VelesError):
     """Requested accelerator platform is unavailable."""

@@ -72,8 +72,11 @@ class GraphicsClient(Logger):
         import matplotlib
         matplotlib.use(backend)
         self.address = address
-        self.output_dir = output_dir or os.path.join(
-            os.path.expanduser("~"), ".veles_tpu", "plots")
+        from .config import root, get as config_get
+        self.output_dir = output_dir or config_get(
+            root.common.dirs.plots,
+            os.path.join(os.path.expanduser("~"), ".veles_tpu",
+                         "plots"))
         self.fmt = fmt
         self.rendered = 0
         self._sock = None

@@ -64,6 +64,10 @@ class Launcher(Logger):
             "status_token", config_get(root.common.web.token, None))
         self._heartbeat_thread = None
         self._heartbeat_stop = threading.Event()
+        self._graph_dot_ = None
+        self._beat_count_ = 0
+        self._plots_sent_ = None
+        self._plots_cache_ = {}
         self.graphics_server = None
         # Remote worker spawn (reference: launcher.py:809-843
         # paramiko/SSH _launch_nodes): ``nodes`` lists worker hosts —
@@ -315,7 +319,75 @@ class Launcher(Logger):
                       "power": desc.power,
                       "blacklisted": desc.blacklisted}
                 for sid, desc in self.server.slaves.items()}
+        # Dashboard depth (reference: web_status.py:113-243 shows the
+        # Graphviz workflow graph and plot links): the DOT text rides
+        # the first beat and a ~per-minute refresh (the dashboard
+        # merges missing sections from the previous beat), plots ride
+        # only when a PNG actually changed.
+        if wf is not None and self._graph_dot_ is None:
+            try:
+                self._graph_dot_ = wf.generate_graph(
+                    write_on_disk=False)
+            except Exception:
+                self._graph_dot_ = ""
+        self._beat_count_ += 1
+        if self._graph_dot_ and (self._beat_count_ == 1 or
+                                 self._beat_count_ % 12 == 0):
+            payload["graph"] = self._graph_dot_
+        plots = self._collect_plots()
+        if plots is not None:
+            payload["plots"] = plots
         return payload
+
+    #: Per-plot and per-beat byte budgets for heartbeat plot payloads.
+    PLOT_BYTES_MAX = 256 * 1024
+    PLOTS_PER_BEAT = 4
+
+    def _collect_plots(self):
+        """Base64 of the most recent rendered plot PNGs.  Returns None
+        when nothing changed since the last beat (the encoding cache
+        is keyed by (path, mtime, size) so an hours-long run does not
+        re-read and re-encode static PNGs every 5 seconds)."""
+        import base64
+        import glob
+        plot_dir = config_get(root.common.dirs.plots, None)
+        if not plot_dir or not os.path.isdir(plot_dir):
+            return None
+        entries = []
+        for path in glob.glob(os.path.join(plot_dir, "*.png")):
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue  # deleted between glob and stat
+            entries.append((st.st_mtime, path, st.st_size))
+        entries.sort(reverse=True)
+        keys = tuple((p, m, s) for m, p, s in
+                     entries[:self.PLOTS_PER_BEAT])
+        if keys == self._plots_sent_:
+            return None
+        out = {}
+        cache = self._plots_cache_
+        for mtime, path, size in entries[:self.PLOTS_PER_BEAT]:
+            if size > self.PLOT_BYTES_MAX:
+                continue
+            name = os.path.splitext(os.path.basename(path))[0]
+            cached = cache.get(path)
+            if cached is not None and cached[0] == (mtime, size):
+                out[name] = cached[1]
+                continue
+            try:
+                with open(path, "rb") as fin:
+                    blob = base64.b64encode(fin.read()).decode()
+            except OSError:
+                continue
+            cache[path] = ((mtime, size), blob)
+            out[name] = blob
+        # Drop cache entries for files that no longer exist.
+        live = {p for _, p, _ in entries}
+        for path in [p for p in cache if p not in live]:
+            del cache[path]
+        self._plots_sent_ = keys
+        return out
 
     def _apply_command(self, cmd):
         """Dashboard commands arriving via the heartbeat response

@@ -20,6 +20,7 @@ its generator state.
 """
 
 import contextlib
+import logging
 
 import numpy
 
@@ -192,6 +193,22 @@ def reset():
 # generator is reproducible by construction — only the module-level
 # sampling functions, which draw from hidden global state, are banned.
 
+#: Extra path prefixes whose callers the guard treats as user code
+#: (raise, not warn) — Main registers the workflow file's directory.
+_guarded_paths = set()
+
+#: Call sites already warned about (outside-framework callers warn
+#: once instead of raising — see _PoisonedRandom.__getattr__).
+_warned_sites = set()
+
+
+def guard_path(path):
+    """Registers a directory whose code the guard treats as user
+    workflow code: stray draws from there RAISE."""
+    import os as _os
+    _guarded_paths.add(_os.path.abspath(path))
+
+
 #: Attributes that stay reachable while poisoned: constructing an
 #: explicitly seeded generator is reproducible; the hidden-global-state
 #: module functions are not.
@@ -220,25 +237,38 @@ class _PoisonedRandom(object):
         # The guard targets user/framework code: third-party internals
         # (e.g. jax's k8s retry jitter, scipy import plumbing) draw
         # from numpy.random legitimately and are outside the
-        # reproducibility contract — let their calls through.  A draw
-        # the user *routes through* such a library (scipy rvs with no
-        # random_state) also escapes; the guard is a tripwire for
-        # direct stray use, not a sandbox.  veles_tpu frames never
-        # qualify, even from an installed (site-packages) copy.
+        # reproducibility contract.  Calls from veles_tpu itself or
+        # from registered workflow paths RAISE; everything else only
+        # warns (once per call site) — a library installed outside
+        # site-packages (pip -e, source checkout) must not crash a
+        # working run.  A draw the user routes *through* such a
+        # library also escapes: this is a tripwire for direct stray
+        # use, not a sandbox.
         import sys as _sys
         frame = _sys._getframe(1)
         caller = frame.f_code.co_filename
-        if ("site-packages" in caller or "dist-packages" in caller) \
-                and ("veles_tpu" not in caller):
-            return getattr(object.__getattribute__(self, "_real"),
-                           item)
-        raise AttributeError(
+        message = (
             "veles_tpu.prng forbids direct numpy.random.%s during a "
             "run — it draws from hidden global state and breaks "
             "reproducibility. Use prng.get().%s / unit.rand().%s, an "
             "explicitly seeded numpy.random.RandomState, or wrap "
             "third-party code in prng.unpoisoned()." %
             (item, item, item))
+        import os as _os
+        if "veles_tpu" in caller or \
+                caller.startswith(_os.getcwd()) or any(
+                caller.startswith(p) for p in _guarded_paths):
+            raise AttributeError(message)
+        if "site-packages" not in caller and \
+                "dist-packages" not in caller:
+            site = (caller, frame.f_lineno)
+            if site not in _warned_sites:
+                _warned_sites.add(site)
+                logging.getLogger("prng").warning(
+                    "%s (called from %s:%d — warning only: the "
+                    "caller is outside the framework and workflow "
+                    "paths)", message, caller, frame.f_lineno)
+        return getattr(object.__getattribute__(self, "_real"), item)
 
 
 def poison_numpy_random():

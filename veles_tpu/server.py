@@ -29,6 +29,7 @@ import statistics
 import threading
 import time
 
+from .distributable import SniffedLock
 from .logger import Logger
 from .network_common import (Channel, machine_id, normalize_secret,
                              parse_address)
@@ -65,7 +66,9 @@ class Server(Logger):
         self._sock.bind((self.host, self.port))
         self.port = self._sock.getsockname()[1]
         self._sock.listen(16)
-        self._lock = threading.Lock()        # serializes workflow
+        # Serializes workflow access across handler threads; sniffs
+        # and reports acquisitions stuck past DEADLOCK_TIME.
+        self._lock = SniffedLock(name="master.workflow_lock")
         self._slaves = {}
         self._slave_seq = 0
         self._stop = threading.Event()

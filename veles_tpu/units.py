@@ -308,6 +308,22 @@ class Unit(Distributable, metaclass=UnitRegistry):
             return
         if self.gate_block:
             return
+        if self.stopped:
+            # Run-after-stop: a control-flow-link error (or an external
+            # stop racing the queue drain).  Warn by default, raise when
+            # root.common.exceptions.run_after_stop is set (reference:
+            # units.py:793-819).
+            from .error import RunAfterStopError
+            msg = ("%s's run() was called after stop() — check the "
+                   "control-flow links of workflow %s" %
+                   (self.name, self.workflow))
+            if bool(root.common.exceptions.get("run_after_stop",
+                                               False)):
+                raise RunAfterStopError(msg)
+            self.warning(
+                "%s (set root.common.exceptions.run_after_stop to "
+                "raise instead)", msg)
+            return
         if not self.gate_skip:
             if self._is_initialized or self.workflow is None:
                 self._run_timed()

@@ -96,11 +96,20 @@ class WebStatusServer(JsonHttpServer):
     # -- state -------------------------------------------------------------
 
     def update(self, payload):
-        """Records a heartbeat; returns + clears queued commands."""
+        """Records a heartbeat; returns + clears queued commands.
+        Launchers ship heavy static sections (graph, plots) only when
+        new or changed — missing sections carry over from the
+        previous beat."""
         mid = payload.get("id")
         if not mid:
             return []
         with self._lock:
+            prev = self._masters.get(mid)
+            if prev is not None:
+                for section in ("graph", "plots"):
+                    if section not in payload and \
+                            section in prev["payload"]:
+                        payload[section] = prev["payload"][section]
             self._masters[mid] = {"payload": payload,
                                   "received": time.time()}
             self._gc_locked()
@@ -161,10 +170,87 @@ class WebStatusServer(JsonHttpServer):
                  esc(json.dumps(info.get("metrics", {})))) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
                  "</th><th>jobs</th></tr>%s</table>" % wtable
-                 if workers else ""))
+                 if workers else "") +
+                self._render_graph(info.get("graph")) +
+                self._render_plots(info.get("plots")))
         return _PAGE.format(rows="\n".join(rows) or
                             "<p>nothing running.</p>",
                             count=len(status))
+
+    #: DOT → rendered-SVG-img cache (graphviz layout is expensive and
+    #: the graph is static; render each distinct DOT once, not per
+    #: page load). Class-level, bounded.
+    _SVG_CACHE = {}
+    _SVG_CACHE_MAX = 32
+
+    @classmethod
+    def _render_graph(cls, dot):
+        """Workflow graph section (reference: web_status.py:113-243
+        shows the Graphviz graph).  When the graphviz binary exists
+        the DOT is rendered server-side to SVG and embedded as a
+        data-URI <img> (img context: embedded scripts in a hostile
+        SVG never execute); the DOT source is always available in a
+        collapsible block."""
+        if not dot or not isinstance(dot, str) or len(dot) > 65536:
+            return ""
+        import base64
+        import hashlib
+        import shutil
+        import subprocess
+        key = hashlib.sha256(dot.encode()).hexdigest()
+        svg_img = cls._SVG_CACHE.get(key)
+        if svg_img is None:
+            svg_img = ""
+            dot_bin = shutil.which("dot")
+            if dot_bin:
+                try:
+                    proc = subprocess.run(
+                        [dot_bin, "-Tsvg"], input=dot.encode(),
+                        capture_output=True, timeout=10)
+                    if proc.returncode == 0:
+                        svg_img = (
+                            '<p><img alt="workflow graph" '
+                            'src="data:image/svg+xml;base64,%s">'
+                            "</p>" % base64.b64encode(
+                                proc.stdout).decode())
+                except (OSError, subprocess.SubprocessError):
+                    pass
+            if len(cls._SVG_CACHE) >= cls._SVG_CACHE_MAX:
+                cls._SVG_CACHE.clear()
+            cls._SVG_CACHE[key] = svg_img
+        return ("<h3>graph</h3>" + svg_img +
+                "<details><summary>workflow graph (DOT)</summary>"
+                "<pre>%s</pre></details>" %
+                html.escape(dot, quote=True))
+
+    @staticmethod
+    def _render_plots(plots):
+        """Latest plot images riding the heartbeat, embedded as
+        data-URI <img> after validating each blob really is a PNG."""
+        if not isinstance(plots, dict) or not plots:
+            return ""
+        import base64
+        imgs = []
+        for name in sorted(plots)[:8]:
+            blob = plots[name]
+            if not isinstance(blob, str) or len(blob) > 512 * 1024:
+                continue
+            try:
+                raw = base64.b64decode(blob, validate=True)
+            except (ValueError, TypeError):
+                continue
+            if not raw.startswith(b"\x89PNG\r\n\x1a\n"):
+                continue
+            imgs.append(
+                '<figure style="display:inline-block">'
+                '<img alt="%s" style="max-width:420px" '
+                'src="data:image/png;base64,%s">'
+                "<figcaption>%s</figcaption></figure>" %
+                (html.escape(str(name), quote=True), blob,
+                 html.escape(str(name), quote=True)))
+        if not imgs:
+            return ""
+        return "<h3>plots</h3>" + "".join(imgs)
 
     # -- lifecycle: start/serve/stop inherited from JsonHttpServer ---------
 
