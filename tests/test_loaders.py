@@ -115,6 +115,40 @@ def test_image_loader_mirror(image_tree):
     assert loader.class_lengths == [0, 0, 16]
 
 
+def test_image_loader_rotations(image_tree):
+    """rotations inflate the TRAIN set with rotated copies
+    (reference: image.py:294-312); quarter turns are exact."""
+    import math
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+    wf = DummyWorkflow()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[str(image_tree / "train")],
+        size=(16, 16), minibatch_size=4,
+        rotations=(0.0, math.pi / 2, 0.1))
+    loader.initialize()
+    # 8 images x 3 rotations
+    assert loader.class_lengths == [0, 0, 24]
+    data = loader.original_data.mem
+    # With sorted rotations (0.0, 0.1, pi/2): block 0 is unrotated,
+    # the last block is the exact quarter turn of it.
+    numpy.testing.assert_allclose(
+        data[16:24], numpy.rot90(data[:8], k=1, axes=(1, 2)),
+        rtol=1e-6)
+    # labels replicate per rotation
+    labs = loader.original_labels.mem
+    assert list(labs[:8]) == list(labs[8:16]) == list(labs[16:24])
+
+
+def test_image_loader_rotations_validate():
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+    wf = DummyWorkflow()
+    with pytest.raises(TypeError):
+        AutoLabelFileImageLoader(wf, train_paths=[], rotations=[0.0])
+    with pytest.raises(ValueError):
+        AutoLabelFileImageLoader(wf, train_paths=[],
+                                 rotations=(7.0,))
+
+
 # -- pickles / hdf5 --------------------------------------------------------
 
 def test_pickles_loader(tmp_path):
@@ -274,3 +308,33 @@ def test_downloader_unpacks_local_archive(tmp_path):
     dl2 = Downloader(wf, url="file:///nonexistent",
                      directory=str(target), files=["payload.txt"])
     dl2.initialize()
+
+
+def test_rotation_nonsquare_keeps_shape(image_tree):
+    """Odd quarter turns on non-square targets must stay (h, w, c)."""
+    import math
+    from veles_tpu.loader.image import AutoLabelFileImageLoader
+    wf = DummyWorkflow()
+    loader = AutoLabelFileImageLoader(
+        wf, train_paths=[str(image_tree / "train")],
+        size=(24, 16), minibatch_size=4,
+        rotations=(0.0, math.pi / 2))
+    loader.initialize()
+    assert loader.class_lengths == [0, 0, 16]
+    assert loader.original_data.shape[1:] == (16, 24, 3)
+
+
+def test_rotation_guards_mse_and_streamed(image_tree, tmp_path):
+    import math
+    from veles_tpu.error import BadFormatError
+    from veles_tpu.loader.image import (FileImageMSELoader,
+                                        StreamedFileImageLoader)
+    wf = DummyWorkflow()
+    with pytest.raises(BadFormatError):
+        FileImageMSELoader(
+            wf, train_paths=[str(image_tree / "train")],
+            target_paths=str(tmp_path), rotations=(0.0, 0.1))
+    with pytest.raises(BadFormatError):
+        StreamedFileImageLoader(
+            wf, train_paths=[str(image_tree / "train")],
+            rotations=(0.0, math.pi / 2))

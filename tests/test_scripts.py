@@ -1,0 +1,179 @@
+"""Misc utility scripts (reference: veles/scripts/ — bboxer labeling
+GUI, update_forge bulk refresh, music_features batch extraction)."""
+
+import json
+import math
+import os
+import struct
+import urllib.request
+import wave
+
+import numpy
+import pytest
+
+
+# -- bboxer -------------------------------------------------------------
+
+
+def _png(path):
+    blob = (b"\x89PNG\r\n\x1a\n\x00\x00\x00\rIHDR\x00\x00\x00\x01"
+            b"\x00\x00\x00\x01\x08\x06\x00\x00\x00\x1f\x15\xc4\x89"
+            b"\x00\x00\x00\nIDATx\x9cc\x00\x01\x00\x00\x05\x00\x01"
+            b"\r\n-\xb4\x00\x00\x00\x00IEND\xaeB`\x82")
+    with open(path, "wb") as fout:
+        fout.write(blob)
+
+
+@pytest.fixture
+def bbox_server(tmp_path):
+    from veles_tpu.scripts.bboxer import BBoxerServer
+    _png(tmp_path / "a.png")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    _png(sub / "b.png")
+    (tmp_path / "notes.txt").write_text("not an image")
+    srv = BBoxerServer(str(tmp_path), host="127.0.0.1",
+                       port=0).start()
+    yield srv, tmp_path
+    srv.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, path), timeout=30) as r:
+        return r.status, r.read()
+
+
+def test_bboxer_lists_and_serves_images(bbox_server):
+    srv, tmp = bbox_server
+    _status, blob = _get(srv.port, "/api/images")
+    files = json.loads(blob)
+    assert [f["file"] for f in files] == ["a.png",
+                                          os.path.join("sub", "b.png")]
+    assert not any(f["labeled"] for f in files)
+    status, img = _get(srv.port, "/image/a.png")
+    assert status == 200 and img.startswith(b"\x89PNG")
+    status, page = _get(srv.port, "/")
+    assert b"bboxer" in page and b"canvas" in page
+
+
+def test_bboxer_selection_roundtrip(bbox_server):
+    srv, tmp = bbox_server
+    boxes = [{"x": 1, "y": 2, "w": 30, "h": 40, "label": "cat"}]
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/api/selections" % srv.port,
+        data=json.dumps({"file": "a.png",
+                         "selections": boxes}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200
+    # Sidecar format: <image>.json next to the image (reference
+    # bboxer.py json_file).
+    sidecar = tmp / "a.png.json"
+    assert json.loads(sidecar.read_text())[0]["label"] == "cat"
+    _status, blob = _get(srv.port, "/api/selections?file=a.png")
+    got = json.loads(blob)
+    assert got[0]["w"] == 30.0
+    _status, blob = _get(srv.port, "/api/images")
+    assert [f["labeled"] for f in json.loads(blob)] == [True, False]
+
+
+def test_bboxer_blocks_traversal(bbox_server):
+    srv, _tmp = bbox_server
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv.port, "/image/..%2F..%2Fetc%2Fpasswd")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv.port, "/api/selections?file=../../etc/passwd")
+    assert e.value.code == 404
+
+
+# -- music_features -----------------------------------------------------
+
+
+def _write_wav(path, freq, rate=8000, seconds=0.5):
+    n = int(rate * seconds)
+    t = numpy.arange(n) / rate
+    samples = (0.5 * numpy.sin(2 * math.pi * freq * t) *
+               32767).astype("<i2")
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(samples.tobytes())
+
+
+def test_music_features_report(tmp_path):
+    from veles_tpu.scripts.music_features import MusicFeatures
+    _write_wav(tmp_path / "low.wav", 220)
+    _write_wav(tmp_path / "high.wav", 3000)
+    sub = tmp_path / "skipme"
+    sub.mkdir()
+    _write_wav(sub / "skipped.wav", 440)
+    out = tmp_path / "report.json"
+    n = MusicFeatures().run([str(tmp_path)], str(out),
+                            exclude="skipme")
+    assert n == 2
+    report = json.loads(out.read_text())["features"]
+    by_name = {os.path.basename(f["file"]): f for f in report}
+    assert set(by_name) == {"low.wav", "high.wav"}
+    low, high = by_name["low.wav"], by_name["high.wav"]
+    assert abs(low["duration_s"] - 0.5) < 0.01
+    assert low["rms"] == pytest.approx(0.5 / math.sqrt(2), rel=0.02)
+    # The spectral centroid must track the tone frequency.
+    assert abs(low["spectral_centroid"] - 220) < 120
+    assert high["spectral_centroid"] > 2000
+    assert high["zero_crossing_rate"] > low["zero_crossing_rate"]
+    assert low["log_spectrogram"]["frames"] > 0
+
+
+def test_music_features_include_regex(tmp_path):
+    from veles_tpu.scripts.music_features import find_audio_files
+    _write_wav(tmp_path / "one.wav", 220)
+    _write_wav(tmp_path / "two.wav", 220)
+    (tmp_path / "not_audio.txt").write_text("x")
+    got = find_audio_files([str(tmp_path)], include="one")
+    assert [os.path.basename(p) for p in got] == ["one.wav"]
+    # exclude wins over include (reference semantics)
+    got = find_audio_files([str(tmp_path)], include="wav",
+                           exclude="two")
+    assert [os.path.basename(p) for p in got] == ["one.wav"]
+
+
+# -- update_forge -------------------------------------------------------
+
+
+def test_update_forge_scans_and_uploads(tmp_path, monkeypatch):
+    from veles_tpu.scripts.update_forge import UpdateForge, \
+        scan_packages
+    pkg = tmp_path / "model_a"
+    pkg.mkdir()
+    (pkg / "manifest.json").write_text(json.dumps({
+        "name": "model_a", "workflow": "wf.py", "author": "t",
+        "short_description": "d", "version": "1.0"}))
+    (pkg / "wf.py").write_text("# workflow\n")
+    other = tmp_path / "no_manifest"
+    other.mkdir()
+    assert list(scan_packages([str(tmp_path)])) == [str(pkg)]
+
+    uploads = []
+
+    class FakeClient(object):
+        def __init__(self, server, token=None, timeout=60.0):
+            self.server = server
+
+        def upload(self, package_dir, version=None):
+            uploads.append(package_dir)
+            return {"status": "ok"}
+
+    import veles_tpu.scripts.update_forge as uf
+    monkeypatch.setattr(uf, "ForgeClient", FakeClient)
+    n = UpdateForge().run("http://forge.example", [str(tmp_path)])
+    assert n == 1 and uploads == [str(pkg)]
+
+
+def test_update_forge_requires_server():
+    from veles_tpu.scripts.update_forge import UpdateForge
+    with pytest.raises(ValueError):
+        UpdateForge().run(None, [])

@@ -344,10 +344,12 @@ class StepCompiler(object):
                 metrics["loss"] = ctx.loss
             return ctx.loss, metrics, new_states, outputs
 
-        def apply_updates(params, grads, new_states, gate):
+        def apply_updates(params, grads, new_states, gate,
+                          hypers=None):
             """Runs every GD unit's update rule; ``gate`` (None or a
             0/1 tracer) masks updates out for padded/validation ticks
-            in block mode."""
+            in block mode; ``hypers`` optionally overrides the GD
+            hyperparameters with traced scalars (population path)."""
             import jax.numpy as jnp
             new_params = dict(params)
             for u in forward_units:
@@ -361,7 +363,8 @@ class StepCompiler(object):
                     gstate = {a: new_states[pname(gd, a)]
                               for a in gd.tstate}
                     new_p, new_gs = gd.tupdate(
-                        attr, params[key_], grads[key_], gstate, None)
+                        attr, params[key_], grads[key_], gstate, None,
+                        hypers=hypers)
                     if gate is not None:
                         new_p = jnp.where(gate, new_p, params[key_])
                     new_params[key_] = new_p
@@ -392,7 +395,8 @@ class StepCompiler(object):
                 params, states, batch, consts, key, False)
             return new_states, outputs, metrics
 
-        def block_step(params, states, blocks, consts, key, training):
+        def block_core(params, states, blocks, consts, key, training,
+                       hypers):
             """K minibatch ticks in ONE dispatch: lax.scan over the
             stacked per-tick inputs.  ``training`` is a traced 0/1
             scalar, so train and validation blocks share one compiled
@@ -419,12 +423,17 @@ class StepCompiler(object):
                     loss_fn, has_aux=True)(p)
                 valid = metrics.get("n_valid", jnp.float32(1.0)) > 0
                 gate = jnp.logical_and(training > 0, valid)
-                new_p, new_s = apply_updates(p, grads, new_s, gate)
+                new_p, new_s = apply_updates(p, grads, new_s, gate,
+                                             hypers=hypers)
                 return (new_p, new_s), None
 
             (params, states), _ = lax.scan(
                 body, (params, states), (blocks, tick_ids))
             return params, states
+
+        def block_step(params, states, blocks, consts, key, training):
+            return block_core(params, states, blocks, consts, key,
+                              training, None)
 
         # precision_level 2: force full-f32 MXU passes (the TPU
         # equivalent of the reference's level-2 multipartial
@@ -442,6 +451,8 @@ class StepCompiler(object):
         self._train_fn = train_step
         self._infer_fn = infer_step
         self._block_fn = block_step
+        # Core closures reused by compile_population.
+        self._core_ = (run_forward, apply_updates, block_core)
         self._param_vecs = param_vecs
         self._state_vecs = state_vecs
         self._fingerprint = self.fingerprint()
@@ -495,6 +506,55 @@ class StepCompiler(object):
         for n, v in self._state_vecs.items():
             v.devmem = new_states[n]
         return {}
+
+    # -- population mode (vmapped hyperparameter sweeps) -------------------
+
+    def compile_population(self, hyper_names):
+        """Compiles a population block step: ``jax.vmap`` of the block
+        core over (params, states, hypers), data broadcast.  One XLA
+        program trains EVERY chromosome of a genetics generation
+        simultaneously — hyperparameters become traced step inputs
+        instead of baked constants, so there is exactly one compile
+        per population instead of one per chromosome (SURVEY §7
+        milestone 8: "population evaluation as vmapped short runs")."""
+        import jax
+        if not self._compiled:
+            self.compile()
+        _, _, block_core = self._core_
+        names = tuple(hyper_names)
+
+        def pop_block(pop_params, pop_states, blocks, consts, key,
+                      training, pop_hypers):
+            def one(p, s, h):
+                hypers = {n: h[i] for i, n in enumerate(names)}
+                return block_core(p, s, blocks, consts, key,
+                                  training, hypers)
+            return jax.vmap(one)(pop_params, pop_states, pop_hypers)
+
+        # Same precision contract as the sequential steps (compile()
+        # wraps them under default_matmul_precision at level >= 2).
+        if config_get(root.common.engine.precision_level, 0) >= 2:
+            pop_block = jax.default_matmul_precision("highest")(
+                pop_block)
+        self._pop_block = jax.jit(pop_block, donate_argnums=(0, 1))
+        self._pop_hyper_names = names
+        return self._pop_block
+
+    def population_arrays(self, pop_size):
+        """Tiles the current params/states to a leading population
+        axis (identical initial weights per chromosome — the same
+        fairness the reference got by seeding every subprocess
+        identically)."""
+        import jax.numpy as jnp
+        if not self._compiled:
+            self.compile()
+        params = {n: jnp.broadcast_to(
+            v.devmem, (pop_size,) + tuple(v.shape))
+            for n, v in self._param_vecs.items()}
+        states = {n: jnp.broadcast_to(
+            v.devmem, (pop_size,) + tuple(v.shape))
+            for n, v in self._state_vecs.items()}
+        return params, states
 
 
 class AcceleratedWorkflow(Workflow):

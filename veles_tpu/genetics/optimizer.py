@@ -182,7 +182,25 @@ class GeneticsOptimizer(Logger):
 
     def _run_standalone(self):
         pop = self.population
+        evaluator = self._make_vmap_evaluator()
         while not pop.complete:
+            if evaluator is not None:
+                batch = []
+                while True:
+                    got = pop.acquire()
+                    if got is None:
+                        break
+                    batch.append(got)
+                if not batch:
+                    raise Bug("population stalled: nothing pending "
+                              "yet generation incomplete")
+                fitnesses = evaluator.evaluate(
+                    [genes for _, genes in batch])
+                for (index, _), fitness in zip(batch, fitnesses):
+                    self.debug("chromosome %d -> fitness %.6f",
+                               index, fitness)
+                    pop.record(index, float(fitness))
+                continue
             got = pop.acquire()
             if got is None:
                 raise Bug("population stalled: nothing pending yet "
@@ -199,6 +217,24 @@ class GeneticsOptimizer(Logger):
                        fitness)
             pop.record(index, fitness)
         self._finish()
+
+    def _make_vmap_evaluator(self):
+        """The vmapped generation evaluator when every tune is a GD
+        hyperparameter (SURVEY §7 milestone 8); None → per-chromosome
+        path."""
+        if self.subprocess_mode or not bool(
+                root.common.genetics.get("vmap", True)):
+            return None
+        from .vmap_eval import PopulationEvaluator, hyper_names
+        if hyper_names(self.tunes) is None:
+            return None
+        try:
+            return PopulationEvaluator(self.module, self.tunes,
+                                       self.seed)
+        except Bug as e:
+            self.warning("vmapped population evaluation unavailable "
+                         "(%s); using per-chromosome runs", e)
+            return None
 
     def _run_coordinator(self):
         from ..server import Server

@@ -1,0 +1,184 @@
+"""Vmapped population evaluation — one compiled program per
+generation instead of one process (or one jit compile) per chromosome.
+
+Reference behavior being accelerated: the reference evaluates each
+chromosome by spawning a full ``velescli`` subprocess
+(veles/genetics/optimization_workflow.py:260 ``_exec``) — 50
+chromosomes × G generations = 50·G interpreter startups and model
+compiles.  SURVEY §7 milestone 8 calls for "population evaluation as
+vmapped short runs where possible, subprocess otherwise"; this module
+is the vmapped path.
+
+Applicability: every ``Tune`` leaf must name a gradient-descent
+hyperparameter (``learning_rate``, ``weights_decay``,
+``gradient_moment``, or their ``_bias`` variants) — these become
+traced inputs of the fused step (``GradientDescentBase.tupdate``
+hypers overrides), applied uniformly to every GD unit.  Topology-
+affecting tunes (layer sizes, batch size) change traced shapes and
+stay on the per-chromosome path.
+
+Mechanics: the model workflow is built and initialized ONCE; its
+params/states are tiled to a leading population axis (identical
+initial weights per chromosome — the reference's same-seed fairness);
+``StepCompiler.compile_population`` vmaps the block scan over
+(params, states, hypers) with minibatch data broadcast; the loader's
+ordinary host-side schedule drives epochs; per-chromosome fitness is
+read from the population's on-device epoch accumulators at class
+boundaries, mirroring DecisionGD (fitness = 1 − min validation error,
+decision.py ``get_metric_values``).
+"""
+
+import numpy
+
+from .. import prng
+from ..config import root
+from ..error import Bug
+from ..launcher import Launcher
+from ..loader.base import TRAIN, VALID
+
+#: Tune leaf names the vmapped path can turn into traced step inputs.
+HYPER_ATTRS = frozenset((
+    "learning_rate", "learning_rate_bias",
+    "weights_decay", "weights_decay_bias",
+    "gradient_moment", "gradient_moment_bias",
+))
+
+
+def hyper_names(tunes):
+    """The traced-hyper layout for a tune set, or ``None`` when any
+    tune is not a (uniquely named) GD hyperparameter."""
+    names = []
+    for path, _tune in tunes:
+        leaf = path.rsplit(".", 1)[-1]
+        if leaf not in HYPER_ATTRS or leaf in names:
+            return None
+        names.append(leaf)
+    return tuple(names) if names else None
+
+
+def build_workflow(module, seed):
+    """Builds + initializes the model workflow WITHOUT running it
+    (the population driver owns the epoch loop)."""
+    prng.reset()
+    prng.get(0).seed(seed)
+    state = {}
+
+    def load(WorkflowClass, **kwargs):
+        launcher = Launcher()
+        wf = WorkflowClass(launcher, **kwargs)
+        state["launcher"], state["wf"] = launcher, wf
+        return wf, False
+
+    def main(**kwargs):
+        state["launcher"].initialize(**kwargs)
+
+    module.run(load, main)
+    return state["wf"], state["launcher"]
+
+
+class PopulationEvaluator(object):
+    """Evaluates a whole generation's chromosomes in one vmapped
+    training run."""
+
+    def __init__(self, module, tunes, seed, epochs=None):
+        self.names = hyper_names(tunes)
+        if self.names is None:
+            raise Bug("tunes are not all uniquely-named GD "
+                      "hyperparameters — use the per-chromosome path")
+        self.module = module
+        self.seed = seed
+        self.epochs = epochs
+        # Bake each Tune's default into the config so workflow
+        # construction sees plain numbers (the per-chromosome path
+        # does the same via apply_genes); the actual gene values ride
+        # the traced hypers, never the config.
+        from .core import apply_genes
+        apply_genes(root, tunes, [t.default for _, t in tunes])
+        self.workflow, self.launcher = build_workflow(module, seed)
+        # Snapshot the loader's data schedule so every generation
+        # replays the SAME epoch walk (reseeding alone is not enough:
+        # shuffles compose on top of the previous generation's final
+        # permutation).
+        loader = self.workflow.loader
+        loader.shuffled_indices.map_read()
+        self._loader_indices = numpy.array(
+            loader.shuffled_indices.mem, copy=True)
+        self._loader_offset = loader.global_offset
+        compiler = self.workflow.compiler
+        compiler.compile_population(self.names)
+        if "gradient_moment" in self.names or \
+                "gradient_moment_bias" in self.names:
+            has_velocity = any("/velocity_" in n
+                               for n in compiler._state_vecs)
+            if not has_velocity:
+                raise Bug(
+                    "tuning gradient_moment requires momentum slots: "
+                    "give the GD units a nonzero baseline "
+                    "gradient_moment so velocities are allocated")
+
+    def evaluate(self, genes_matrix, epochs=None):
+        """Trains every chromosome for ``epochs`` full epochs; returns
+        the fitness vector (1 − min validation err, or 1 − min train
+        err for loaders with no validation set — DecisionGD parity)."""
+        import jax
+        import jax.numpy as jnp
+        wf = self.workflow
+        loader = wf.loader
+        compiler = wf.compiler
+        genes = numpy.asarray(genes_matrix, dtype=numpy.float32)
+        pop = genes.shape[0]
+        epochs = epochs or self.epochs or \
+            getattr(wf, "max_epochs", None) or \
+            getattr(getattr(wf, "decision", None), "max_epochs",
+                    None) or 3
+        pop_params, pop_states = compiler.population_arrays(pop)
+        pop_hypers = jnp.asarray(genes)
+        consts = {str(id(v)): v.devmem
+                  for v in compiler.const_vectors}
+        acc_keys = [n for n in pop_states
+                    if n.endswith("/epoch_acc") or
+                    n.endswith("/epoch_acc_c")]
+        if not any(n.endswith("/epoch_acc") for n in acc_keys):
+            raise Bug("population evaluation needs an EvaluatorBase "
+                      "epoch accumulator in the traced chain")
+        K = max(int(getattr(wf, "ticks_per_dispatch", 1) or 1), 8)
+        min_err = {VALID: numpy.full(pop, numpy.inf),
+                   TRAIN: numpy.full(pop, numpy.inf)}
+        saw_class = {VALID: False, TRAIN: False}
+        # Identical randomness AND data schedule for every generation
+        # (the reference reseeded each evaluation subprocess the same
+        # way): reseed the generator and restore the loader's initial
+        # permutation + offset, so epoch-end shuffles replay the same
+        # sequence.  Within a generation all chromosomes share one
+        # schedule + key stream by construction.
+        prng.get(0).seed(self.seed)
+        loader.shuffled_indices.map_write()
+        loader.shuffled_indices.mem[...] = self._loader_indices
+        loader.global_offset = self._loader_offset
+        start_epoch = loader.epoch_number
+        while loader.epoch_number - start_epoch < epochs:
+            blocks = loader.serve_block(K)
+            cls = loader.minibatch_class
+            training = jnp.float32(1.0 if cls == TRAIN else 0.0)
+            key = prng.get().jax_key()
+            pop_params, pop_states = compiler._pop_block(
+                pop_params, pop_states,
+                {bid: jnp.asarray(b) for bid, b in blocks.items()},
+                consts, key, training, pop_hypers)
+            if loader.last_minibatch and cls in min_err:
+                for name in acc_keys:
+                    if not name.endswith("/epoch_acc"):
+                        continue
+                    acc = numpy.asarray(
+                        jax.device_get(pop_states[name]))  # (P, 3, 4)
+                    err = acc[:, cls, 0] / numpy.maximum(
+                        acc[:, cls, 1], 1.0)
+                    min_err[cls] = numpy.minimum(min_err[cls], err)
+                    saw_class[cls] = True
+                # Class epoch closed: zero its accumulator rows
+                # (DecisionGD._fetch_class_metrics parity).
+                for name in acc_keys:
+                    pop_states[name] = \
+                        pop_states[name].at[:, cls].set(0.0)
+        cls = VALID if saw_class[VALID] else TRAIN
+        return 1.0 - min_err[cls]

@@ -43,6 +43,22 @@ class ImageDecoderMixin(object):
         self.keep_aspect_ratio = kwargs.get("keep_aspect_ratio",
                                             False)
         self.background_color = kwargs.get("background_color", 0)
+        # Rotation augmentation (reference: image.py:294-312
+        # ``rotations`` — a tuple of radians; each angle inflates the
+        # TRAIN set with a rotated copy, like ``mirror`` does).
+        rotations = kwargs.get("rotations", (0.0,))
+        if not isinstance(rotations, tuple):
+            raise TypeError("rotations must be a tuple (got %r)" %
+                            (rotations,))
+        for i, rot in enumerate(rotations):
+            if not isinstance(rot, (int, float)):
+                raise TypeError("rotations[%d] = %r is not a number" %
+                                (i, rot))
+            if abs(rot) > 2 * numpy.pi:
+                raise ValueError(
+                    "rotations[%d] = %s exceeds 2π radians" %
+                    (i, rot))
+        self.rotations = tuple(sorted(rotations))
         ntype = kwargs.get("normalization_type", "none")
         self.normalizer = normalizer_factory(
             ntype, **kwargs.get("normalization_parameters", {}))
@@ -60,6 +76,27 @@ class ImageDecoderMixin(object):
         out = numpy.empty(shape, dtype=numpy.float32)
         out[...] = bg
         return out
+
+    def rotate_image(self, arr, angle):
+        """Rotates a decoded (h, w, c) array by ``angle`` radians
+        around its center, background-filled.  Quarter turns are
+        exact (numpy.rot90); arbitrary angles interpolate."""
+        if not angle:
+            return arr
+        quarter = angle / (numpy.pi / 2.0)
+        if abs(quarter - round(quarter)) < 1e-9:
+            k = int(round(quarter)) % 4
+            # The exact fast path must preserve (h, w, c): odd
+            # quarter turns transpose the spatial dims, so non-square
+            # images take the shape-preserving interpolated path.
+            if k % 2 == 0 or arr.shape[0] == arr.shape[1]:
+                return numpy.ascontiguousarray(
+                    numpy.rot90(arr, k=k, axes=(0, 1)))
+        from scipy import ndimage
+        bg = float(numpy.mean(self.background_color))
+        return ndimage.rotate(
+            arr, numpy.degrees(angle), axes=(1, 0), reshape=False,
+            mode="constant", cval=bg).astype(numpy.float32)
 
     def decode_image(self, path):
         from PIL import Image
@@ -126,6 +163,10 @@ class ImageLoaderBase(FullBatchLoader, ImageDecoderMixin):
         lengths = [0, 0, 0]
         for cls in (0, 1, 2):
             arrs, labs = per_class.get(cls, ([], []))
+            if cls == 2 and arrs and self.rotations != (0.0,):
+                arrs = [self.rotate_image(a, rot)
+                        for rot in self.rotations for a in arrs]
+                labs = list(labs) * len(self.rotations)
             if cls == 2 and self.mirror and arrs:
                 arrs = list(arrs) + [a[:, ::-1] for a in arrs]
                 labs = list(labs) + list(labs)
@@ -236,12 +277,12 @@ class FileImageMSELoader(FileImageLoader):
             raise BadFormatError(
                 "%s requires target_paths (a directory or a "
                 "path->path callable)" % self)
-        if self.mirror:
-            # Fail before any decode work: the target would need
-            # mirroring too, which this loader does not do.
+        if self.mirror or self.rotations != (0.0,):
+            # Fail before any decode work: the target would need the
+            # same augmentation, which this loader does not do.
             raise BadFormatError(
-                "mirror augmentation is not supported with MSE "
-                "targets")
+                "mirror/rotation augmentation is not supported with "
+                "MSE targets")
 
     def target_path_for(self, path):
         if callable(self.target_paths):
@@ -303,10 +344,10 @@ class StreamedFileImageLoader(StreamLoader, ImageDecoderMixin,
         super(StreamedFileImageLoader, self).__init__(workflow,
                                                       **kwargs)
         self.init_image_kwargs(kwargs)
-        if self.mirror:
+        if self.mirror or self.rotations != (0.0,):
             raise BadFormatError(
-                "mirror augmentation is not supported by the "
-                "streamed loader")
+                "mirror/rotation augmentation is not supported by "
+                "the streamed loader")
         self.init_path_kwargs(kwargs)
         self.analysis_samples = int(kwargs.get("analysis_samples",
                                                256))

@@ -1,0 +1,246 @@
+"""Bounding-box image labeling GUI (reference:
+veles/scripts/bboxer.py — a Tornado web app serving an image
+directory with a browser labeling UI; selections persist as a
+``<image>.json`` next to each image; thumbnails generated on demand).
+
+TPU-era rebuild on the framework's stdlib HTTP stack
+(``http_common.JsonHttpServer`` — the same machinery behind the
+web-status dashboard and the forge server): a single-page canvas UI,
+an image listing with labeled/unlabeled state, path-traversal-guarded
+image serving, and the same ``file + ".json"`` selection format so
+labels are plain artifacts next to the data.
+
+Usage::
+
+    python -m veles_tpu.scripts.bboxer --root /data/images [--port N]
+"""
+
+import argparse
+import json
+import logging
+import mimetypes
+import os
+import sys
+import urllib.parse
+
+from ..http_common import JsonHttpServer, JsonRequestHandler
+
+IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>veles_tpu bboxer</title><style>
+body { font-family: sans-serif; margin: 0; display: flex; }
+#list { width: 260px; height: 100vh; overflow-y: auto;
+        border-right: 1px solid #ccc; padding: 8px; }
+#list a { display: block; padding: 2px 4px; text-decoration: none;
+          color: #333; }
+#list a.labeled { color: #080; font-weight: bold; }
+#main { flex: 1; padding: 8px; }
+#wrap { position: relative; display: inline-block; }
+canvas { position: absolute; left: 0; top: 0; cursor: crosshair; }
+#bar { margin: 6px 0; }
+</style></head><body>
+<div id="list"></div>
+<div id="main">
+  <div id="bar">
+    label: <input id="label" value="object">
+    <button onclick="save()">save</button>
+    <button onclick="clearBoxes()">clear</button>
+    <span id="status"></span>
+  </div>
+  <div id="wrap"><img id="img"><canvas id="cv"></canvas></div>
+</div>
+<script>
+let current = null, boxes = [], drag = null;
+const img = document.getElementById("img"),
+      cv = document.getElementById("cv"),
+      ctx = cv.getContext("2d");
+async function refresh() {
+  const files = await (await fetch("api/images")).json();
+  const list = document.getElementById("list");
+  list.innerHTML = "";
+  for (const f of files) {
+    const a = document.createElement("a");
+    a.textContent = (f.labeled ? "\\u2713 " : "") + f.file;
+    a.href = "#"; a.className = f.labeled ? "labeled" : "";
+    a.onclick = () => { open_(f.file); return false; };
+    list.appendChild(a);
+  }
+}
+async function open_(f) {
+  current = f;
+  img.src = "image/" + encodeURIComponent(f);
+  await img.decode();
+  cv.width = img.width; cv.height = img.height;
+  boxes = await (await fetch(
+    "api/selections?file=" + encodeURIComponent(f))).json();
+  draw();
+}
+function draw() {
+  ctx.clearRect(0, 0, cv.width, cv.height);
+  ctx.lineWidth = 2; ctx.strokeStyle = "#f00";
+  ctx.font = "13px sans-serif"; ctx.fillStyle = "#f00";
+  for (const b of boxes) {
+    ctx.strokeRect(b.x, b.y, b.w, b.h);
+    ctx.fillText(b.label || "", b.x + 2, b.y + 14);
+  }
+  if (drag) ctx.strokeRect(drag.x, drag.y, drag.w, drag.h);
+}
+cv.onmousedown = e => {
+  drag = {x: e.offsetX, y: e.offsetY, w: 0, h: 0};
+};
+cv.onmousemove = e => {
+  if (!drag) return;
+  drag.w = e.offsetX - drag.x; drag.h = e.offsetY - drag.y; draw();
+};
+cv.onmouseup = e => {
+  if (drag && Math.abs(drag.w) > 3 && Math.abs(drag.h) > 3) {
+    const b = {x: Math.min(drag.x, drag.x + drag.w),
+               y: Math.min(drag.y, drag.y + drag.h),
+               w: Math.abs(drag.w), h: Math.abs(drag.h),
+               label: document.getElementById("label").value};
+    boxes.push(b);
+  }
+  drag = null; draw();
+};
+function clearBoxes() { boxes = []; draw(); }
+async function save() {
+  const r = await fetch("api/selections", {method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify({file: current, selections: boxes})});
+  document.getElementById("status").textContent =
+    r.ok ? "saved" : "save failed";
+  refresh();
+}
+refresh();
+</script></body></html>"""
+
+
+def json_file(path):
+    """Selection sidecar path (reference: bboxer.py ``json_file``)."""
+    return path + ".json"
+
+
+class BBoxerServer(JsonHttpServer):
+    """Labeling backend over one image directory."""
+
+    def __init__(self, root_dir, host="127.0.0.1", port=8083):
+        self.root_dir = os.path.realpath(root_dir)
+        if not os.path.isdir(self.root_dir):
+            raise NotADirectoryError(self.root_dir)
+
+        class Handler(JsonRequestHandler):
+            def do_GET(self):
+                outer = self.outer
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path in ("/", "/index.html"):
+                    self.reply(200, _PAGE, "text/html")
+                elif parsed.path == "/api/images":
+                    self.reply(200, outer.list_images())
+                elif parsed.path == "/api/selections":
+                    params = urllib.parse.parse_qs(parsed.query)
+                    name = (params.get("file") or [""])[0]
+                    try:
+                        self.reply(200, outer.get_selections(name))
+                    except (KeyError, OSError):
+                        self.reply(404, {"error": "unknown image"})
+                elif parsed.path.startswith("/image/"):
+                    name = urllib.parse.unquote(
+                        parsed.path[len("/image/"):])
+                    try:
+                        blob, ctype = outer.read_image(name)
+                    except (KeyError, OSError):
+                        self.reply(404, {"error": "unknown image"})
+                        return
+                    self.reply(200, blob, ctype)
+                else:
+                    self.reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                outer = self.outer
+                if self.path != "/api/selections":
+                    self.reply(404, {"error": "not found"})
+                    return
+                try:
+                    payload = self.read_json()
+                    outer.save_selections(payload["file"],
+                                          payload["selections"])
+                except (ValueError, KeyError, TypeError):
+                    self.reply(400, {"error": "bad selection payload"})
+                    return
+                self.reply(200, {"status": "saved"})
+
+        super(BBoxerServer, self).__init__(
+            Handler, host=host, port=port, thread_name="veles-bboxer")
+
+    # -- backend ops -------------------------------------------------------
+
+    def _resolve(self, name):
+        """Path inside the root, or KeyError (traversal guard)."""
+        path = os.path.realpath(os.path.join(self.root_dir, name))
+        if not path.startswith(self.root_dir + os.sep):
+            raise KeyError(name)
+        return path
+
+    def list_images(self):
+        out = []
+        for dirpath, _dirs, names in sorted(os.walk(self.root_dir)):
+            for fname in sorted(names):
+                if not fname.lower().endswith(IMAGE_EXTENSIONS):
+                    continue
+                full = os.path.join(dirpath, fname)
+                rel = os.path.relpath(full, self.root_dir)
+                out.append({
+                    "file": rel,
+                    "labeled": os.path.isfile(json_file(full))})
+        return out
+
+    def read_image(self, name):
+        path = self._resolve(name)
+        if not path.lower().endswith(IMAGE_EXTENSIONS):
+            raise KeyError(name)
+        ctype = mimetypes.guess_type(path)[0] or \
+            "application/octet-stream"
+        with open(path, "rb") as fin:
+            return fin.read(), ctype
+
+    def get_selections(self, name):
+        sidecar = json_file(self._resolve(name))
+        if not os.path.isfile(sidecar):
+            return []
+        with open(sidecar) as fin:
+            return json.load(fin)
+
+    def save_selections(self, name, selections):
+        path = self._resolve(name)
+        clean = []
+        for b in selections:
+            clean.append({
+                "x": float(b["x"]), "y": float(b["y"]),
+                "w": float(b["w"]), "h": float(b["h"]),
+                "label": str(b.get("label", ""))[:128]})
+        with open(json_file(path), "w") as fout:
+            json.dump(clean, fout, indent=2)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu.scripts.bboxer")
+    parser.add_argument("--root", required=True,
+                        help="image directory to label")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8083)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    server = BBoxerServer(args.root, host=args.host, port=args.port)
+    print("bboxer on http://%s:%d/ labeling %s" %
+          (args.host, server.port, args.root))
+    try:
+        server.serve()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Bulk forge refresh: re-upload every workflow package that carries a
+manifest (reference: veles/scripts/update_forge.py — scans the sample
+workflows and ``velescli forge upload``s each one that has a forge
+manifest; server from the FORGE_SERVER environment variable).
+
+Usage::
+
+    python -m veles_tpu.scripts.update_forge [--server URL]
+        [--token T] [DIR ...]
+
+With no directories, the bundled sample workflows are scanned.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+from ..forge import MANIFEST_NAME
+from ..forge.client import ForgeClient
+from ..logger import Logger
+
+
+def scan_packages(dirs):
+    """Yields every subdirectory (or the directory itself) holding a
+    forge manifest."""
+    for base in dirs:
+        if os.path.isfile(os.path.join(base, MANIFEST_NAME)):
+            yield base
+            continue
+        for name in sorted(os.listdir(base)):
+            sub = os.path.join(base, name)
+            if os.path.isdir(sub) and \
+                    os.path.isfile(os.path.join(sub, MANIFEST_NAME)):
+                yield sub
+
+
+def default_scan_dirs():
+    samples = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "znicz", "samples")
+    return [samples] if os.path.isdir(samples) else []
+
+
+class UpdateForge(Logger):
+    def run(self, server, dirs, token=None):
+        if not server:
+            raise ValueError(
+                "no forge server: pass --server or set the "
+                "FORGE_SERVER environment variable")
+        client = ForgeClient(server, token=token)
+        uploaded = skipped = 0
+        for package_dir in scan_packages(dirs):
+            try:
+                reply = client.upload(package_dir)
+                self.info("updated %s -> %s", package_dir, reply)
+                uploaded += 1
+            except Exception as e:
+                self.warning("failed to upload %s: %s", package_dir, e)
+                skipped += 1
+        self.info("%d package(s) updated, %d failed", uploaded,
+                  skipped)
+        return uploaded
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu.scripts.update_forge")
+    parser.add_argument("--server",
+                        default=os.getenv("FORGE_SERVER"))
+    parser.add_argument("--token",
+                        default=os.getenv("FORGE_TOKEN"))
+    parser.add_argument("dirs", nargs="*",
+                        help="package directories (or parents of "
+                             "them); default: bundled samples")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    dirs = args.dirs or default_scan_dirs()
+    UpdateForge().run(args.server, dirs, token=args.token)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -232,20 +232,61 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
                     v.initialize(self.device)
                     self._velocities[slot] = v
 
-    def _hyper(self, attr):
+    def _hyper(self, attr, hypers=None):
         if attr == "bias":
-            return (self.learning_rate_bias, self.weights_decay_bias,
-                    self.gradient_moment_bias)
-        return (self.learning_rate, self.weights_decay,
-                self.gradient_moment)
+            own = (self.learning_rate_bias, self.weights_decay_bias,
+                   self.gradient_moment_bias)
+            suffix = "_bias"
+        else:
+            own = (self.learning_rate, self.weights_decay,
+                   self.gradient_moment)
+            suffix = ""
+        if not hypers:
+            return own
+        # Traced overrides (population evaluation).  A plain traced
+        # hyper reaches the bias slot only when the unit's own bias
+        # value is TIED to its plain value (the constructor-default
+        # case: learning_rate_bias/gradient_moment_bias default to
+        # the plain ones, weights_decay_bias defaults to 0.0) — an
+        # explicitly decoupled *_bias keeps its own value, so the
+        # vmapped path trains the same model the per-chromosome path
+        # does.
+        names = ("learning_rate", "weights_decay", "gradient_moment")
+        plain = (self.learning_rate, self.weights_decay,
+                 self.gradient_moment)
+        out = []
+        for name, own_v, plain_v in zip(names, own, plain):
+            if suffix:
+                tied_default = hypers.get(name, own_v) \
+                    if own_v == plain_v else own_v
+                out.append(hypers.get(name + suffix, tied_default))
+            else:
+                out.append(hypers.get(name, own_v))
+        return tuple(out)
 
-    def tupdate(self, attr, param, grad, state, ctx):
+    def tupdate(self, attr, param, grad, state, ctx, hypers=None):
         """Classic momentum SGD with L2 decay (AlexNet-era rule used by
-        znicz GD units): v ← μv − lr·(g + λp); p ← p + v."""
-        lr, decay, moment = self._hyper(attr)
-        g = grad + decay * param if decay else grad
+        znicz GD units): v ← μv − lr·(g + λp); p ← p + v.
+
+        ``hypers`` optionally overrides the Python-float
+        hyperparameters with traced scalars (the vmapped population
+        path evaluates every chromosome in one compiled program, so
+        its hypers must be step *inputs*, not baked constants)."""
+        lr, decay, moment = self._hyper(attr, hypers)
         slot = "velocity_" + attr
         new_state = {}
+        if hypers:
+            # Traced values: no Python truth tests; the momentum
+            # branch is decided by the (static) presence of the slot.
+            g = grad + decay * param
+            if slot in state:
+                v = moment * state[slot] - lr * g
+                new_param = param + v
+                new_state[slot] = v
+            else:
+                new_param = param - lr * g
+            return new_param, new_state
+        g = grad + decay * param if decay else grad
         if moment and slot in state:
             v = moment * state[slot] - lr * g
             new_param = param + v
