@@ -104,8 +104,17 @@ class PopulationEvaluator(object):
         self._loader_indices = numpy.array(
             loader.shuffled_indices.mem, copy=True)
         self._loader_offset = loader.global_offset
+        self._loader_epoch = loader.epoch_number
         compiler = self.workflow.compiler
         compiler.compile_population(self.names)
+        if not any(n.endswith("/epoch_acc")
+                   for n in compiler._state_vecs):
+            # Raised at construction so _make_vmap_evaluator's Bug
+            # handler falls back to the per-chromosome path (which
+            # reads fitness via gather_results, not epoch
+            # accumulators).
+            raise Bug("population evaluation needs an EvaluatorBase "
+                      "epoch accumulator in the traced chain")
         if "gradient_moment" in self.names or \
                 "gradient_moment_bias" in self.names:
             has_velocity = any("/velocity_" in n
@@ -138,9 +147,6 @@ class PopulationEvaluator(object):
         acc_keys = [n for n in pop_states
                     if n.endswith("/epoch_acc") or
                     n.endswith("/epoch_acc_c")]
-        if not any(n.endswith("/epoch_acc") for n in acc_keys):
-            raise Bug("population evaluation needs an EvaluatorBase "
-                      "epoch accumulator in the traced chain")
         K = max(int(getattr(wf, "ticks_per_dispatch", 1) or 1), 8)
         min_err = {VALID: numpy.full(pop, numpy.inf),
                    TRAIN: numpy.full(pop, numpy.inf)}
@@ -152,9 +158,15 @@ class PopulationEvaluator(object):
         # sequence.  Within a generation all chromosomes share one
         # schedule + key stream by construction.
         prng.get(0).seed(self.seed)
+        if getattr(loader, "prng_key", 0) != 0:
+            # The loader shuffles from its OWN generator.
+            prng.get(loader.prng_key).seed(self.seed)
         loader.shuffled_indices.map_write()
         loader.shuffled_indices.mem[...] = self._loader_indices
         loader.global_offset = self._loader_offset
+        # epoch_number also resets: shuffle_limit compares against it,
+        # and the per-generation walk must be byte-identical.
+        loader.epoch_number = self._loader_epoch
         start_epoch = loader.epoch_number
         while loader.epoch_number - start_epoch < epochs:
             blocks = loader.serve_block(K)

@@ -44,8 +44,10 @@ class ImageDecoderMixin(object):
                                             False)
         self.background_color = kwargs.get("background_color", 0)
         # Rotation augmentation (reference: image.py:294-312
-        # ``rotations`` — a tuple of radians; each angle inflates the
-        # TRAIN set with a rotated copy, like ``mirror`` does).
+        # ``rotations`` — a tuple of radians; the TRAIN set holds one
+        # variant PER LISTED ANGLE, so include 0.0 to keep the
+        # unrotated originals — (0.0, a) doubles the set, (a,) alone
+        # REPLACES it with rotated copies).
         rotations = kwargs.get("rotations", (0.0,))
         if not isinstance(rotations, tuple):
             raise TypeError("rotations must be a tuple (got %r)" %
@@ -59,6 +61,12 @@ class ImageDecoderMixin(object):
                     "rotations[%d] = %s exceeds 2π radians" %
                     (i, rot))
         self.rotations = tuple(sorted(rotations))
+        if self.rotations and 0.0 not in self.rotations:
+            import logging
+            logging.getLogger(type(self).__name__).warning(
+                "rotations %s does not include 0.0 — the TRAIN set "
+                "will contain ONLY rotated variants (one per listed "
+                "angle), not the originals", self.rotations)
         ntype = kwargs.get("normalization_type", "none")
         self.normalizer = normalizer_factory(
             ntype, **kwargs.get("normalization_parameters", {}))

@@ -1,0 +1,177 @@
+"""Attention ops: full, blockwise (flash-style), and ring
+(sequence-parallel) formulations.
+
+The reference framework predates attention entirely (2013-15, SURVEY
+§5 "long-context: ABSENT in reference"), but long-context support is
+a first-class obligation of the TPU build: sequences too long for one
+chip's HBM shard along a mesh ``seq`` axis, and attention streams the
+key/value shards around the ring over ICI (``lax.ppermute``) with an
+online-softmax accumulator, so no device ever materializes the full
+S×S score matrix or the full K/V.
+
+Design notes (the "How to Scale Your Model" recipe):
+  * all three formulations share one streaming-softmax block update —
+    parity between them is structural, not coincidental;
+  * accumulation is float32 regardless of input dtype (bf16 scores
+    lose the softmax tail);
+  * everything is ``lax.scan``/``ppermute`` — differentiable, so the
+    backward pass is the same ring reversed, inserted by autodiff;
+  * causal masking works on GLOBAL positions: each ring step offsets
+    its key block by the sending device's shard start.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_update(acc, m, l, q, k, v, *, scale, mask=None):
+    """One streaming-softmax update: fold the (q·kᵀ) scores of a
+    key/value block into the running (acc, m, l) accumulator.
+
+    Shapes: q (B, Sq, H, D); k/v (B, Sk, H, D); acc (B, Sq, H, D) f32;
+    m/l (B, Sq, H) f32.  ``mask`` (Sq, Sk) True = attend.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bqhk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, :, None, :], scores, NEG_INF)
+    block_max = scores.max(axis=-1)
+    new_m = jnp.maximum(m, block_max)
+    correction = jnp.exp(m - new_m)
+    p = jnp.exp(scores - new_m[..., None])
+    if mask is not None:
+        # exp(NEG_INF - m) underflows to 0 already; this guards the
+        # fully-masked-row case where new_m itself is NEG_INF.
+        p = jnp.where(mask[None, :, None, :], p, 0.0)
+    new_l = l * correction + p.sum(axis=-1)
+    new_acc = acc * correction[..., None] + jnp.einsum(
+        "bqhk,bkhd->bqhd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32)
+    return new_acc, new_m, new_l
+
+
+def _finish(acc, l, dtype):
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(dtype)
+
+
+def _causal_mask(sq, sk, q_offset, k_offset):
+    qpos = q_offset + jnp.arange(sq)[:, None]
+    kpos = k_offset + jnp.arange(sk)[None, :]
+    return qpos >= kpos
+
+
+def attention(q, k, v, causal=False):
+    """Full O(S²)-memory attention (B, S, H, D) — the reference
+    formulation the streaming variants are tested against."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    mask = _causal_mask(q.shape[1], k.shape[1], 0, 0) if causal \
+        else None
+    B, Sq, H, D = q.shape
+    acc = jnp.zeros((B, Sq, H, D), jnp.float32)
+    m = jnp.full((B, Sq, H), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, Sq, H), jnp.float32)
+    acc, m, l = _block_update(acc, m, l, q, k, v, scale=scale,
+                              mask=mask)
+    return _finish(acc, l, q.dtype)
+
+
+def blockwise_attention(q, k, v, block_size=128, causal=False):
+    """Flash-style attention: scan over key/value blocks with the
+    streaming accumulator — O(S·block) memory on one device."""
+    B, S, H, D = q.shape
+    if S % block_size:
+        raise ValueError("sequence %d not divisible by block %d" %
+                         (S, block_size))
+    nblocks = S // block_size
+    scale = 1.0 / (D ** 0.5)
+    kb = k.reshape(B, nblocks, block_size, H, D)
+    vb = v.reshape(B, nblocks, block_size, H, D)
+
+    def body(carry, xs):
+        acc, m, l = carry
+        kblk, vblk, idx = xs
+        mask = _causal_mask(S, block_size, 0, idx * block_size) \
+            if causal else None
+        acc, m, l = _block_update(acc, m, l, q, kblk, vblk,
+                                  scale=scale, mask=mask)
+        return (acc, m, l), None
+
+    init = (jnp.zeros((B, S, H, D), jnp.float32),
+            jnp.full((B, S, H), NEG_INF, jnp.float32),
+            jnp.zeros((B, S, H), jnp.float32))
+    (acc, m, l), _ = lax.scan(
+        body, init,
+        (kb.swapaxes(0, 1), vb.swapaxes(0, 1), jnp.arange(nblocks)))
+    return _finish(acc, l, q.dtype)
+
+
+def ring_attention(q, k, v, axis_name, causal=False):
+    """Sequence-parallel attention INSIDE ``shard_map``: each device
+    holds its (B, S/N, H, D) shard; N ring steps ppermute the k/v
+    shard to the next device while folding the arriving block into
+    the local queries' accumulator.  Communication rides ICI and
+    overlaps the einsums; peak memory per device is O(S/N) — the
+    long-context enabler.
+    """
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    q_offset = rank * Sq
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, step):
+        acc, m, l, kr, vr = carry
+        # The k/v block currently held arrived from `rank - step`.
+        src = (rank - step) % n
+        if causal:
+            mask = _causal_mask(Sq, kr.shape[1], q_offset, src * Sq)
+        else:
+            mask = None
+        acc, m, l = _block_update(acc, m, l, q, kr, vr, scale=scale,
+                                  mask=mask)
+        kr = lax.ppermute(kr, axis_name, perm)
+        vr = lax.ppermute(vr, axis_name, perm)
+        return (acc, m, l, kr, vr), None
+
+    init = (jnp.zeros((B, Sq, H, D), jnp.float32),
+            jnp.full((B, Sq, H), NEG_INF, jnp.float32),
+            jnp.zeros((B, Sq, H), jnp.float32), k, v)
+    (acc, m, l, _, _), _ = lax.scan(body, init, jnp.arange(n))
+    return _finish(acc, l, q.dtype)
+
+
+def sequence_parallel_attention(q, k, v, mesh, seq_axis,
+                                causal=False, batch_axis=None):
+    """Wraps :func:`ring_attention` in ``shard_map`` over the mesh's
+    sequence axis (activations (B, S, H, D) sharded on dim 1), usable
+    from inside an outer jit: GSPMD reshards the operands to the
+    in_specs, the ring runs explicit ppermutes over ICI, and the
+    result comes back sequence-sharded.  ``batch_axis`` keeps the
+    batch dim data-parallel inside the shard_map (dp × sp composes:
+    the ring psums only over ``seq_axis``)."""
+    import inspect
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    sig = inspect.signature(shard_map).parameters
+    # Disable replication/varying-axis checking: the ring's carried
+    # k/v blocks change their varying-axis type across ppermute steps.
+    _kw = {"check_vma": False} if "check_vma" in sig \
+        else {"check_rep": False}
+    from jax.sharding import PartitionSpec as P
+    if batch_axis is not None and batch_axis not in mesh.axis_names:
+        batch_axis = None
+    spec = P(batch_axis, seq_axis, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        **_kw)
+    return fn(q, k, v)

@@ -1,2 +1,3 @@
 from .mesh import (make_mesh, apply_dp_sharding,  # noqa: F401
-                   apply_dp_tp_sharding, rebuild_mesh)
+                   apply_dp_tp_sharding, apply_dp_sp_sharding,
+                   rebuild_mesh)

@@ -153,6 +153,36 @@ def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
     return workflow
 
 
+def apply_dp_sp_sharding(workflow, mesh, data_axis="data",
+                         seq_axis="seq"):
+    """Data × sequence parallelism — the long-context layout
+    (SURVEY §5: absent in the 2013-15 reference; first-class here):
+    batches shard on ``data_axis`` exactly as in DP, and every
+    TransformerBlock whose ``seq_axis`` names a mesh axis runs its
+    attention as a ``shard_map`` ring over that axis
+    (ops/attention.py ``ring_attention`` — k/v shards rotate over ICI
+    with a streaming-softmax accumulator, so per-device activation
+    memory scales as S/N and no device ever holds full K/V).
+
+    Params stay replicated; gradients of the mean loss psum over the
+    data axis via GSPMD as in DP; the ring's own collectives are
+    explicit ppermutes inserted by the unit.
+    """
+    apply_dp_sharding(workflow, mesh, axis=data_axis)
+    ring_blocks = 0
+    for unit in getattr(workflow, "forwards", []):
+        if getattr(unit, "seq_axis", None) == seq_axis:
+            unit.batch_axis = data_axis
+            ring_blocks += 1
+    if ring_blocks == 0:
+        workflow.warning(
+            "apply_dp_sp_sharding: no forward unit declares "
+            "seq_axis=%r — the workflow runs data-parallel only"
+            % seq_axis)
+    workflow._parallel_style_ = ("dp_sp", data_axis, seq_axis)
+    return workflow
+
+
 def rebuild_mesh(workflow, surviving_devices=None, axis="data",
                  requeue_in_flight=True):
     """Elastic recovery after chip loss (the mesh-granularity

@@ -1,0 +1,281 @@
+"""Transformer / long-context units.
+
+The reference framework predates attention (SURVEY §5: long-context
+"ABSENT in reference" — 2013-15, no attention anywhere), but the TPU
+build treats long sequences as first-class: these units extend the
+znicz layer family with an embedding, a pre-LN transformer block
+whose attention can run **ring sequence-parallel** over a mesh
+``seq`` axis (``ops/attention.py``: streaming-softmax k/v rotation
+via ``lax.ppermute`` — no device materializes full K/V), and a
+language-model evaluator wired into the standard on-device epoch
+accounting.  Everything composes with the existing machinery: the
+fused StepCompiler differentiates through the ring, the generic
+GradientDescentBase momentum rule updates every trainable, snapshots
+and the distributed contract come from ForwardBase.
+"""
+
+import numpy
+
+from ..memory import Vector
+from .nn_units import ForwardBase, GradientDescentBase
+from .evaluator import EvaluatorBase
+
+
+def _layer_norm(x, gamma, beta, eps=1e-5):
+    import jax.numpy as jnp
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    return ((xf - mu) * jnp.reciprocal(jnp.sqrt(var + eps)) * gamma +
+            beta).astype(x.dtype)
+
+
+class Embedding(ForwardBase):
+    """Token + learned positional embedding: int32 tokens (B, S) →
+    activations (B, S, E)."""
+
+    MAPPING = "embedding"
+
+    def __init__(self, workflow, **kwargs):
+        super(Embedding, self).__init__(workflow, **kwargs)
+        self.vocab_size = kwargs["vocab_size"]
+        self.embed_dim = kwargs["embed_dim"]
+        self.max_len = kwargs.get("max_len")
+        self.include_bias = False
+        self.pos = Vector()
+
+    @property
+    def trainables(self):
+        t = {"weights": self.weights} if self.weights else {}
+        if self.pos:
+            t["pos"] = self.pos
+        return t
+
+    def initialize(self, device=None, **kwargs):
+        super(Embedding, self).initialize(device=device, **kwargs)
+        batch, seq = self.input.shape[:2]
+        max_len = self.max_len or seq
+        if not self.weights:
+            stddev = self.weights_stddev or 0.02
+            w = numpy.zeros((self.vocab_size, self.embed_dim),
+                            dtype=numpy.float32)
+            self.rand().fill_normal(w, stddev=stddev)
+            self.weights.mem = w
+            self.weights.initialize(self.device)
+        if not self.pos:
+            p = numpy.zeros((max_len, self.embed_dim),
+                            dtype=numpy.float32)
+            self.rand().fill_normal(p, stddev=0.02)
+            self.pos.mem = p
+            self.pos.initialize(self.device)
+        self.output.mem = numpy.zeros(
+            (batch, seq, self.embed_dim), dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        tokens = read(self.input).astype("int32")
+        w = params["weights"]
+        seq = tokens.shape[1]
+        out = w[tokens] + params["pos"][:seq]
+        write(self.output, out.astype(self.compute_dtype))
+
+
+class TransformerBlock(ForwardBase):
+    """Pre-LN transformer block: x + MHA(LN(x)), then + MLP(LN(·)).
+
+    kwargs: ``n_heads``; ``mlp_ratio`` (default 4); ``causal``
+    (default True); ``seq_axis`` — when set AND the workflow's mesh
+    carries that axis, attention runs ring sequence-parallel
+    (``ops.attention.sequence_parallel_attention``); otherwise
+    blockwise/full attention on-device.
+    """
+
+    MAPPING = "transformer_block"
+
+    PARAM_NAMES = ("ln1_g", "ln1_b", "wq", "wk", "wv", "wo",
+                   "bq", "bk", "bv", "bo",
+                   "ln2_g", "ln2_b", "w1", "b1", "w2", "b2")
+
+    def __init__(self, workflow, **kwargs):
+        super(TransformerBlock, self).__init__(workflow, **kwargs)
+        self.n_heads = kwargs.get("n_heads", 4)
+        self.mlp_ratio = kwargs.get("mlp_ratio", 4)
+        self.causal = kwargs.get("causal", True)
+        self.seq_axis = kwargs.get("seq_axis")
+        self.batch_axis = kwargs.get("batch_axis", "data")
+        self.params = {name: Vector() for name in self.PARAM_NAMES}
+
+    @property
+    def trainables(self):
+        return {n: v for n, v in self.params.items() if v}
+
+    def initialize(self, device=None, **kwargs):
+        super(TransformerBlock, self).initialize(device=device,
+                                                 **kwargs)
+        batch, seq, embed = self.input.shape
+        if embed % self.n_heads:
+            raise ValueError("embed dim %d not divisible by %d heads"
+                             % (embed, self.n_heads))
+        hidden = embed * self.mlp_ratio
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(embed))
+        shapes = {
+            "ln1_g": (embed,), "ln1_b": (embed,),
+            "wq": (embed, embed), "wk": (embed, embed),
+            "wv": (embed, embed), "wo": (embed, embed),
+            "bq": (embed,), "bk": (embed,), "bv": (embed,),
+            "bo": (embed,),
+            "ln2_g": (embed,), "ln2_b": (embed,),
+            "w1": (embed, hidden), "b1": (hidden,),
+            "w2": (hidden, embed), "b2": (embed,),
+        }
+        for name, shape in shapes.items():
+            vec = self.params[name]
+            if vec:
+                continue
+            arr = numpy.zeros(shape, dtype=numpy.float32)
+            if name.startswith("w"):
+                self.rand().fill_normal(arr, stddev=stddev)
+            elif name.endswith("_g"):
+                arr[...] = 1.0
+            vec.mem = arr
+            vec.initialize(self.device)
+        self.output.mem = numpy.zeros((batch, seq, embed),
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def _attend(self, q, k, v):
+        from ..ops import attention as A
+        mesh = getattr(self.workflow, "mesh", None)
+        if self.seq_axis and mesh is not None and \
+                self.seq_axis in mesh.axis_names:
+            return A.sequence_parallel_attention(
+                q, k, v, mesh, self.seq_axis, causal=self.causal,
+                batch_axis=self.batch_axis)
+        return A.attention(q, k, v, causal=self.causal)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        x = read(self.input)
+        B, S, E = x.shape
+        H = self.n_heads
+        cdt = self.compute_dtype
+
+        def dot(a, w, b):
+            return jnp.dot(a.astype(cdt), w.astype(cdt),
+                           preferred_element_type=jnp.float32) + b
+
+        h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+        q = dot(h, params["wq"], params["bq"]).reshape(B, S, H, -1)
+        k = dot(h, params["wk"], params["bk"]).reshape(B, S, H, -1)
+        v = dot(h, params["wv"], params["bv"]).reshape(B, S, H, -1)
+        attn = self._attend(q.astype(cdt), k.astype(cdt),
+                            v.astype(cdt)).reshape(B, S, E)
+        x = x + dot(attn, params["wo"], params["bo"])
+        h = _layer_norm(x, params["ln2_g"], params["ln2_b"])
+        h = jnp.maximum(dot(h, params["w1"], params["b1"]), 0.0)
+        x = x + dot(h, params["w2"], params["b2"])
+        write(self.output, x.astype(jnp.float32))
+
+
+class LMHead(ForwardBase):
+    """Tied or free projection to vocabulary logits:
+    (B, S, E) → (B, S, V)."""
+
+    MAPPING = "lm_head"
+
+    def __init__(self, workflow, **kwargs):
+        super(LMHead, self).__init__(workflow, **kwargs)
+        self.vocab_size = kwargs["vocab_size"]
+        #: Weight tying to an Embedding unit (standard LM practice;
+        #: gradients flow to the embedding through the read).
+        self.tie_to = kwargs.get("tie_to")
+
+    @property
+    def trainables(self):
+        if self.tie_to is not None:
+            return {"bias": self.bias} if self.include_bias and \
+                self.bias else {}
+        return super(LMHead, self).trainables
+
+    def initialize(self, device=None, **kwargs):
+        if self.tie_to is not None and \
+                not self.tie_to.is_initialized:
+            raise AttributeError("%s: tied embedding %s not "
+                                 "initialized yet" %
+                                 (self.name, self.tie_to.name))
+        super(LMHead, self).initialize(device=device, **kwargs)
+        batch, seq, embed = self.input.shape
+        if self.tie_to is None and not self.weights:
+            stddev = self.weights_stddev or (1.0 / numpy.sqrt(embed))
+            w = numpy.zeros((embed, self.vocab_size),
+                            dtype=numpy.float32)
+            self.rand().fill_normal(w, stddev=stddev)
+            self.weights.mem = w
+            self.weights.initialize(self.device)
+        if self.include_bias and not self.bias:
+            self.bias.mem = numpy.zeros(self.vocab_size,
+                                        dtype=numpy.float32)
+            self.bias.initialize(self.device)
+        self.output.mem = numpy.zeros(
+            (batch, seq, self.vocab_size), dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax.numpy as jnp
+        x = read(self.input)
+        cdt = self.compute_dtype
+        if self.tie_to is not None:
+            w = read(self.tie_to.weights).T
+        else:
+            w = params["weights"]
+        y = jnp.dot(x.astype(cdt), w.astype(cdt),
+                    preferred_element_type=jnp.float32)
+        if self.include_bias:
+            y = y + params["bias"]
+        write(self.output, y)
+
+
+class EvaluatorLM(EvaluatorBase):
+    """Next-token cross-entropy over (B, S, V) logits vs (B, S)
+    labels, with per-SAMPLE validity mask; rides the on-device epoch
+    accumulator like every evaluator (n_err/n_valid count tokens)."""
+
+    def __init__(self, workflow, **kwargs):
+        super(EvaluatorLM, self).__init__(workflow, **kwargs)
+        self.labels = None
+        self.demand("labels", "mask", "minibatch_class_vec")
+
+    def tforward(self, read, write, params, ctx, state=None):
+        import jax
+        import jax.numpy as jnp
+        logits = read(self.input)
+        labels = read(self.labels).astype(jnp.int32)
+        mask = read(self.mask)
+        tokens_per = labels.shape[1]
+        tok_mask = mask[:, None] * jnp.ones((1, tokens_per),
+                                            jnp.float32)
+        n_valid = jnp.maximum(tok_mask.sum(), 1.0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32),
+                                  axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, labels[..., None], axis=-1)[..., 0]
+        loss = (nll * tok_mask).sum() / n_valid
+        pred = jnp.argmax(logits, axis=-1)
+        n_err = ((pred != labels) * tok_mask).sum()
+        ctx.set_loss(loss)
+        ctx.add_metric("n_err", n_err)
+        ctx.add_metric("n_valid", tok_mask.sum())
+        return self._accumulate(read, state, n_err, tok_mask.sum(),
+                                loss)
+
+
+class GDEmbedding(GradientDescentBase):
+    MAPPING = "embedding"
+
+
+class GDTransformerBlock(GradientDescentBase):
+    MAPPING = "transformer_block"
+
+
+class GDLMHead(GradientDescentBase):
+    MAPPING = "lm_head"

@@ -257,8 +257,13 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
         out = []
         for name, own_v, plain_v in zip(names, own, plain):
             if suffix:
-                tied_default = hypers.get(name, own_v) \
-                    if own_v == plain_v else own_v
+                # weights_decay_bias constructor-defaults to 0.0, NOT
+                # to weights_decay — so a traced plain decay must
+                # never leak onto biases (the per-chromosome path
+                # keeps bias decay at its own value).
+                ties = name != "weights_decay" and own_v == plain_v
+                tied_default = hypers.get(name, own_v) if ties \
+                    else own_v
                 out.append(hypers.get(name + suffix, tied_default))
             else:
                 out.append(hypers.get(name, own_v))
