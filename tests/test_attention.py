@@ -78,10 +78,11 @@ def test_fully_masked_rows_are_finite():
 
 def _train_tinylm(**kwargs):
     from veles_tpu.znicz.samples.tinylm import TinyLMWorkflow
+    kwargs.setdefault("max_epochs", 8)
     prng.reset()
     prng.get(0).seed(3)
     launcher = Launcher()
-    wf = TinyLMWorkflow(launcher, max_epochs=8, **kwargs)
+    wf = TinyLMWorkflow(launcher, **kwargs)
     launcher.initialize()
     return launcher, wf
 
@@ -121,3 +122,191 @@ def test_tinylm_snapshot_roundtrip(tmp_path):
     b2 = wf2.forwards[1].params["wq"]
     b2.map_read()
     numpy.testing.assert_array_equal(w1, numpy.array(b2.mem))
+
+
+# -- expert parallelism (MoE) -------------------------------------------
+
+
+def test_top1_routing_respects_capacity():
+    import jax.numpy as jnp
+    from veles_tpu.ops.moe import top1_routing
+    rng = numpy.random.RandomState(0)
+    # All tokens prefer expert 0 — capacity must cap its queue.
+    logits = numpy.zeros((16, 4), numpy.float32)
+    logits[:, 0] = 5.0
+    dispatch, combine, aux, load = top1_routing(
+        jnp.asarray(logits), capacity=4)
+    d = numpy.asarray(dispatch)
+    assert d[:, 0].sum() == 4.0          # only 4 tokens kept
+    assert d[:, 1:].sum() == 0.0
+    # Each occupied slot holds exactly one token.
+    assert (d.sum(axis=0) <= 1.0 + 1e-6).all()
+    assert float(load[0]) == 16.0        # pre-capacity load
+    assert float(aux) > 1.0              # imbalance penalized
+
+
+def test_moe_ffn_matches_dense_when_one_expert():
+    """With E=1 and ample capacity, MoE degenerates to the dense FFN
+    (gate=1) — pins the dispatch/combine algebra."""
+    import jax.numpy as jnp
+    from veles_tpu.ops.moe import moe_ffn
+    rng = numpy.random.RandomState(1)
+    T, D, H = 12, 8, 16
+    x = rng.normal(0, 1, (T, D)).astype(numpy.float32)
+    router = rng.normal(0, 1, (D, 1)).astype(numpy.float32)
+    w1 = rng.normal(0, 0.3, (1, D, H)).astype(numpy.float32)
+    b1 = rng.normal(0, 0.1, (1, H)).astype(numpy.float32)
+    w2 = rng.normal(0, 0.3, (1, H, D)).astype(numpy.float32)
+    b2 = rng.normal(0, 0.1, (1, D)).astype(numpy.float32)
+    y, aux, load = moe_ffn(jnp.asarray(x), router, w1, b1, w2, b2,
+                           capacity_factor=2.0)
+    want = numpy.maximum(x @ w1[0] + b1[0], 0.0) @ w2[0] + b2[0]
+    numpy.testing.assert_allclose(numpy.asarray(y), want, rtol=1e-4,
+                                  atol=1e-5)
+    assert float(load[0]) == T
+
+
+def test_tinylm_moe_expert_parallel_training():
+    """dp(2) × ep(4): the MoE variant trains to the gate with expert
+    params sharded one-expert-per-device."""
+    from veles_tpu.parallel import apply_dp_ep_sharding
+    launcher, wf = _train_tinylm(n_experts=4, learning_rate=0.02,
+                                 max_epochs=10)
+    mesh = make_mesh(axes={"data": 2, "expert": 4})
+    apply_dp_ep_sharding(wf, mesh)
+    assert wf._parallel_style_[0] == "dp_ep"
+    block = wf.forwards[1]
+    assert block.params["w1"].sharding.spec[0] == "expert"
+    launcher.run()
+    assert wf.decision.min_validation_err < 0.1
+
+
+# -- pipeline parallelism -----------------------------------------------
+
+
+def _stack_params(n_stages, E=16, H=2, seed=0):
+    from veles_tpu.znicz.attention import TransformerBlock
+    rng = numpy.random.RandomState(seed)
+    hidden = E * 4
+    shapes = {
+        "ln1_g": (E,), "ln1_b": (E,), "wq": (E, E), "wk": (E, E),
+        "wv": (E, E), "wo": (E, E), "bq": (E,), "bk": (E,),
+        "bv": (E,), "bo": (E,), "ln2_g": (E,), "ln2_b": (E,),
+        "w1": (E, hidden), "b1": (hidden,), "w2": (hidden, E),
+        "b2": (E,),
+    }
+    params = {}
+    for name in TransformerBlock.PARAM_NAMES:
+        shape = (n_stages,) + shapes[name]
+        if name.endswith("_g"):
+            params[name] = numpy.ones(shape, numpy.float32)
+        elif name.startswith("w"):
+            params[name] = rng.normal(0, 0.1, shape) \
+                .astype(numpy.float32)
+        else:
+            params[name] = numpy.zeros(shape, numpy.float32)
+    return params
+
+
+def test_gpipe_matches_sequential():
+    """The collective-permute pipeline over a 4-stage mesh computes
+    EXACTLY the sequential composition of the same stacked layers."""
+    import jax.numpy as jnp
+    from veles_tpu.ops.pipeline import gpipe, sequential_stack
+    from veles_tpu.znicz.attention import transformer_block_apply
+    params = _stack_params(4)
+    x = numpy.random.RandomState(1).normal(
+        0, 1, (8, 12, 16)).astype(numpy.float32)
+
+    def fn(p, h):
+        return transformer_block_apply(p, h, n_heads=2, causal=True,
+                                       cdt=jnp.float32)
+
+    seq = sequential_stack(fn, params, jnp.asarray(x))
+    mesh = make_mesh(axes={"stage": 4})
+    pipe = gpipe(fn, params, jnp.asarray(x), mesh, "stage",
+                 n_microbatches=4)
+    numpy.testing.assert_allclose(numpy.asarray(pipe),
+                                  numpy.asarray(seq),
+                                  rtol=2e-5, atol=2e-5)
+
+
+def test_gpipe_gradients_match_sequential():
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.ops.pipeline import gpipe, sequential_stack
+    from veles_tpu.znicz.attention import transformer_block_apply
+    params = _stack_params(4, seed=2)
+    x = numpy.random.RandomState(3).normal(
+        0, 1, (4, 8, 16)).astype(numpy.float32)
+
+    def fn(p, h):
+        return transformer_block_apply(p, h, n_heads=2, causal=True,
+                                       cdt=jnp.float32)
+
+    mesh = make_mesh(axes={"stage": 4})
+    g_seq = jax.grad(lambda p: (sequential_stack(
+        fn, p, jnp.asarray(x)) ** 2).sum())(params)
+    g_pipe = jax.grad(lambda p: (gpipe(
+        fn, p, jnp.asarray(x), mesh, "stage", 2) ** 2).sum())(params)
+    for name in params:
+        numpy.testing.assert_allclose(
+            numpy.asarray(g_pipe[name]), numpy.asarray(g_seq[name]),
+            rtol=1e-3, atol=1e-4, err_msg=name)
+
+
+def test_tinylm_pipeline_parallel_training():
+    """dp(2) × pp(4): a 4-block pipelined stack trains to the gate."""
+    from veles_tpu.parallel import apply_dp_pp_sharding
+    launcher, wf = _train_tinylm(n_blocks=4, pipelined=True,
+                                 stage_axis="stage",
+                                 learning_rate=0.02, max_epochs=10)
+    mesh = make_mesh(axes={"data": 2, "stage": 4})
+    apply_dp_pp_sharding(wf, mesh)
+    assert wf._parallel_style_[0] == "dp_pp"
+    stack = wf.forwards[1]
+    assert stack.params["wq"].sharding.spec[0] == "stage"
+    launcher.run()
+    assert wf.decision.min_validation_err < 0.1
+
+
+def test_gpipe_multiple_blocks_per_stage():
+    """n_layers = 2 × stages: each device applies its local sub-stack
+    sequentially; result still equals the full sequential stack."""
+    import jax.numpy as jnp
+    from veles_tpu.ops.pipeline import gpipe, sequential_stack
+    from veles_tpu.znicz.attention import transformer_block_apply
+    params = _stack_params(8, seed=4)
+    x = numpy.random.RandomState(5).normal(
+        0, 1, (4, 8, 16)).astype(numpy.float32)
+
+    def fn(p, h):
+        return transformer_block_apply(p, h, n_heads=2, causal=True,
+                                       cdt=jnp.float32)
+
+    seq = sequential_stack(fn, params, jnp.asarray(x))
+    mesh = make_mesh(axes={"stage": 4})
+    pipe = gpipe(fn, params, jnp.asarray(x), mesh, "stage",
+                 n_microbatches=2)
+    numpy.testing.assert_allclose(numpy.asarray(pipe),
+                                  numpy.asarray(seq),
+                                  rtol=2e-5, atol=2e-5)
+
+
+def test_pipelined_stack_falls_back_when_indivisible():
+    """A 3-block stack on a 4-stage mesh stays sequential (the
+    apply_dp_pp_sharding contract) instead of crashing in shard_map."""
+    from veles_tpu.parallel import apply_dp_pp_sharding
+    launcher, wf = _train_tinylm(n_blocks=3, pipelined=True,
+                                 stage_axis="stage",
+                                 learning_rate=0.02, max_epochs=2)
+    mesh = make_mesh(axes={"data": 2, "stage": 4})
+    apply_dp_pp_sharding(wf, mesh)  # warns, leaves replicated
+    launcher.run()  # must not raise
+    assert wf.decision.epoch_number == 2
+
+
+def test_tinylm_rejects_pipelined_moe():
+    from veles_tpu.znicz.samples.tinylm import TinyLMWorkflow
+    with pytest.raises(ValueError):
+        TinyLMWorkflow(Launcher(), pipelined=True, n_experts=4)

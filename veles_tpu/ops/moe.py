@@ -1,0 +1,84 @@
+"""Mixture-of-Experts dispatch (GShard-style top-1 routing with
+capacity) — the expert-parallel building block.
+
+Not in the 2013-15 reference (SURVEY §5); part of the TPU build's
+first-class scaling matrix (dp/tp/sp/ep).  The formulation is the
+standard einsum dispatch: a (tokens, experts, capacity) one-hot
+dispatch tensor gathers each expert's tokens, the expert FFNs run as
+one batched einsum over the expert dimension, and a combine einsum
+scatters outputs back weighted by the router gate.  Under a mesh with
+an ``expert`` axis the expert dimension of the parameters and of the
+dispatched activations shards there — XLA lowers the dispatch/combine
+einsums to all-to-alls over ICI, exactly the manual A2A of expert-
+parallel frameworks, without hand-written collectives.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_routing(logits, capacity):
+    """Top-1 router (GShard): per-token expert choice with a
+    per-expert capacity limit.
+
+    Args:
+      logits: (T, E) router scores.
+      capacity: int — max tokens an expert accepts; overflow tokens
+        are DROPPED (their combine weights are zero → residual path
+        carries them, the standard top-1 behavior).
+
+    Returns:
+      dispatch: (T, E, C) 0/1 — token t occupies slot c of expert e;
+      combine:  (T, E, C) float — dispatch · gate probability;
+      aux_loss: load-balance auxiliary (mean_e f_e · p_e · E, the
+        Switch/GShard formulation);
+      expert_load: (E,) tokens routed per expert (pre-capacity).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate = probs.max(axis=-1)
+    expert = probs.argmax(axis=-1)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+    # Position of each token within its expert's queue.
+    position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot
+    keep = (position < capacity) * onehot          # (T, E)
+    slot = position.sum(axis=-1).astype(jnp.int32)  # queue index
+    dispatch = keep[:, :, None] * jax.nn.one_hot(
+        slot, capacity, dtype=jnp.float32)[:, None, :]
+    combine = dispatch * gate[:, None, None]
+    # Load-balance aux: fraction routed × mean prob, summed over
+    # experts, scaled by E (Switch Transformer eq. 4).
+    f = onehot.mean(axis=0)
+    p = probs.mean(axis=0)
+    aux_loss = (f * p).sum() * E
+    return dispatch, combine, aux_loss, onehot.sum(axis=0)
+
+
+def moe_ffn(x, router_w, w1, b1, w2, b2, capacity_factor=1.25):
+    """Top-1 MoE feed-forward over tokens.
+
+    Args:
+      x: (T, D) tokens; router_w: (D, E);
+      w1: (E, D, H); b1: (E, H); w2: (E, H, D); b2: (E, D).
+
+    Returns (y (T, D), aux_loss, expert_load (E,)).
+    """
+    T, D = x.shape
+    E = router_w.shape[1]
+    capacity = max(1, int(capacity_factor * T / E))
+    logits = x.astype(jnp.float32) @ router_w
+    dispatch, combine, aux, load = top1_routing(logits, capacity)
+    # Gather each expert's tokens: (E, C, D).
+    expert_in = jnp.einsum("tec,td->ecd", dispatch,
+                           x.astype(jnp.float32),
+                           preferred_element_type=jnp.float32)
+    h = jnp.maximum(jnp.einsum(
+        "ecd,edh->ech", expert_in, w1,
+        preferred_element_type=jnp.float32) + b1[:, None, :], 0.0)
+    expert_out = jnp.einsum(
+        "ech,ehd->ecd", h, w2,
+        preferred_element_type=jnp.float32) + b2[:, None, :]
+    # Scatter back with gate weighting: dropped tokens get zeros.
+    y = jnp.einsum("tec,ecd->td", combine, expert_out,
+                   preferred_element_type=jnp.float32)
+    return y, aux, load

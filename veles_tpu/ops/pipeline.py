@@ -1,0 +1,126 @@
+"""GPipe-style pipeline parallelism over a mesh ``stage`` axis.
+
+Not in the 2013-15 reference (its only parallelism was master–slave
+DP, SURVEY §2.3); completes the TPU build's scaling matrix
+(dp/tp/sp/ep/pp).  The formulation is the standard collective-permute
+pipeline: a stack of IDENTICALLY-SHAPED layer applications is laid
+out one stage per device (stacked parameters shard on their leading
+stage dimension), the batch splits into M microbatches, and for
+S + M − 1 steps each device applies its stage to the microbatch it
+holds while ``lax.ppermute`` hands activations to the next stage —
+the classic bubble of S − 1 idle slots per ramp.  Everything is
+``lax.scan`` + ``ppermute`` inside ``shard_map``, so autodiff derives
+the backward pipeline (reverse ring) automatically.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pipeline_body(fn, params, x_mb, axis_name):
+    """The per-device pipeline loop.  ``params``: this stage's layer
+    parameters (stage dim already sliced away by shard_map);
+    ``x_mb``: (M, mb, ...) microbatched input, replicated."""
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    M = x_mb.shape[0]
+    steps = M + n_stages - 1
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+    mb_shape = x_mb.shape[1:]
+    out_acc = jnp.zeros((M,) + mb_shape, jnp.float32)
+
+    def body(carry, t):
+        recv, acc = carry
+        # Stage 0 injects microbatch t (zeros once the ramp ends);
+        # later stages consume what arrived from stage-1.
+        feed_idx = jnp.clip(t, 0, M - 1)
+        fresh = jnp.where(t < M, x_mb[feed_idx],
+                          jnp.zeros(mb_shape, x_mb.dtype))
+        inp = jnp.where(stage == 0, fresh.astype(jnp.float32), recv)
+        out = fn(params, inp)
+        # The LAST stage finishes microbatch t−(S−1) at step t.
+        mb_done = t - (n_stages - 1)
+        is_last = stage == n_stages - 1
+        valid = jnp.logical_and(is_last, mb_done >= 0)
+        slot = jnp.clip(mb_done, 0, M - 1)
+        acc = jnp.where(
+            valid,
+            acc.at[slot].set(out.astype(jnp.float32)),
+            acc)
+        # Hand the activation to the next stage (last stage sends
+        # nothing anyone reads).
+        recv = lax.ppermute(out, axis_name, perm)
+        return (recv, acc), None
+
+    init = (jnp.zeros(mb_shape, jnp.float32), out_acc)
+    (_, acc), _ = lax.scan(body, init, jnp.arange(steps))
+    # Only the last stage holds real outputs; psum replicates them
+    # (every other stage contributes zeros).
+    return lax.psum(acc, axis_name)
+
+
+def gpipe(fn, stacked_params, x, mesh, stage_axis, n_microbatches):
+    """Runs ``y = fn(p[S-1], …fn(p[1], fn(p[0], x))…)`` microbatch-
+    pipelined over the mesh's ``stage_axis``.
+
+    Args:
+      fn: (layer_params, activation (mb, ...)) → activation, same
+        shape class in and out (stages must be homogeneous).
+      stacked_params: pytree whose leaves carry a leading S dim.
+      x: (B, ...) input; B must divide into ``n_microbatches``.
+      mesh / stage_axis: where the stages live.
+      n_microbatches: M; the bubble fraction is (S−1)/(M+S−1).
+
+    Returns y (B, ...) float32, replicated over the stage axis.
+    """
+    try:
+        from jax import shard_map
+        import inspect
+        _kw = {"check_vma": False} if "check_vma" in \
+            inspect.signature(shard_map).parameters else {}
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+        _kw = {"check_rep": False}
+    from jax.sharding import PartitionSpec as P
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError("batch %d not divisible into %d microbatches"
+                         % (B, n_microbatches))
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    n_stages = mesh.shape[stage_axis]
+    if n_layers % n_stages:
+        raise ValueError(
+            "%d stacked layers do not divide over %d pipeline "
+            "stages" % (n_layers, n_stages))
+    mb = B // n_microbatches
+    x_mb = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    def stage_fn(params, x_all):
+        # shard_map leaves each device a (n_layers/n_stages, ...)
+        # local sub-stack; a stage applies its local layers in
+        # sequence (scan), so n_layers may be any multiple of the
+        # stage count.
+        return _pipeline_body(
+            lambda p, h: sequential_stack(fn, p, h),
+            params, x_all, stage_axis)
+
+    pspec = jax.tree_util.tree_map(
+        lambda p: P(stage_axis, *([None] * (p.ndim - 1))),
+        stacked_params)
+    out = shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(pspec, P()), out_specs=P(), **_kw)(
+            stacked_params, x_mb)
+    return out.reshape((B,) + out.shape[2:])
+
+
+def sequential_stack(fn, stacked_params, x):
+    """The no-mesh reference path: the same stacked layers applied by
+    a plain scan — pipelined and sequential must agree exactly."""
+    def body(h, params):
+        return fn(params, h), None
+    y, _ = lax.scan(body, x.astype(jnp.float32), stacked_params)
+    return y

@@ -183,6 +183,111 @@ def apply_dp_sp_sharding(workflow, mesh, data_axis="data",
     return workflow
 
 
+def apply_dp_ep_sharding(workflow, mesh, data_axis="data",
+                         expert_axis="expert"):
+    """Data × EXPERT parallelism for Mixture-of-Experts blocks
+    (znicz/attention.py MoETransformerBlock): each MoE block's
+    expert-stacked parameters (leading ``n_experts`` dimension) and
+    their mirroring optimizer slots shard along ``expert_axis``; the
+    GShard dispatch/combine einsums (ops/moe.py) then contract a
+    sharded expert dimension against replicated tokens, and XLA
+    lowers them to the all-to-all pattern of expert-parallel
+    frameworks over ICI.  Everything else follows DP.
+
+    Blocks whose ``n_experts`` does not divide the expert-axis size
+    stay replicated (correct, merely not expert-parallel).
+    """
+    apply_dp_sharding(workflow, mesh, axis=data_axis)
+    n_exp = mesh.shape[expert_axis]
+    gd_of = {gd.target: gd
+             for gd in getattr(workflow, "gds", [])
+             if getattr(gd, "target", None) is not None}
+    sharded_blocks = 0
+    for unit in getattr(workflow, "forwards", []):
+        expert_params = getattr(unit, "expert_params", None)
+        if expert_params is None:
+            continue
+        if unit.n_experts % n_exp:
+            continue
+        for vec in expert_params.values():
+            ndim = len(vec.shape)
+            spec = PartitionSpec(expert_axis,
+                                 *([None] * (ndim - 1)))
+            vec.sharding = NamedSharding(mesh, spec)
+        sharded_blocks += 1
+        gd = gd_of.get(unit)
+        if gd is not None:
+            # Optimizer slots match their parameter BY NAME
+            # (velocity_<param>) — shape matching would mis-shard
+            # e.g. velocity_router when router (D, E) happens to
+            # collide with b2 (E, D).
+            for name, vec in gd.tstate.items():
+                pname = name[len("velocity_"):] \
+                    if name.startswith("velocity_") else name
+                target = expert_params.get(pname)
+                if vec and target is not None and \
+                        tuple(vec.shape) == tuple(target.shape):
+                    vec.sharding = target.sharding
+    if sharded_blocks == 0:
+        workflow.warning(
+            "apply_dp_ep_sharding: no MoE block's n_experts divides "
+            "the expert axis (%d) — the workflow runs data-parallel "
+            "only" % n_exp)
+    workflow._parallel_style_ = ("dp_ep", data_axis, expert_axis)
+    return workflow
+
+
+def apply_dp_pp_sharding(workflow, mesh, data_axis="data",
+                         stage_axis="stage"):
+    """Data × PIPELINE parallelism (znicz/attention.py
+    PipelinedTransformerStack + ops/pipeline.py ``gpipe``): each
+    stack's stage-stacked parameters (leading ``n_blocks`` dim) and
+    their mirroring optimizer slots shard one stage per device along
+    ``stage_axis``; inside the step the stack runs the collective-
+    permute pipeline over that axis with microbatching.  Everything
+    else follows DP.
+
+    Stacks whose ``n_blocks`` does not divide the stage-axis size
+    stay replicated (they then run the sequential scan — correct,
+    merely not pipelined).
+    """
+    apply_dp_sharding(workflow, mesh, axis=data_axis)
+    n_stage = mesh.shape[stage_axis]
+    gd_of = {gd.target: gd
+             for gd in getattr(workflow, "gds", [])
+             if getattr(gd, "target", None) is not None}
+    sharded_stacks = 0
+    for unit in getattr(workflow, "forwards", []):
+        stage_params = getattr(unit, "stage_params", None)
+        if stage_params is None:
+            continue
+        if unit.n_blocks % n_stage:
+            continue
+        for vec in stage_params.values():
+            spec = PartitionSpec(stage_axis,
+                                 *([None] * (len(vec.shape) - 1)))
+            vec.sharding = NamedSharding(mesh, spec)
+        sharded_stacks += 1
+        gd = gd_of.get(unit)
+        if gd is not None:
+            # By-name slot matching (velocity_<param>), as in the
+            # expert helper.
+            for name, vec in gd.tstate.items():
+                pname = name[len("velocity_"):] \
+                    if name.startswith("velocity_") else name
+                target = stage_params.get(pname)
+                if vec and target is not None and \
+                        tuple(vec.shape) == tuple(target.shape):
+                    vec.sharding = target.sharding
+    if sharded_stacks == 0:
+        workflow.warning(
+            "apply_dp_pp_sharding: no pipelined stack's n_blocks "
+            "divides the stage axis (%d) — the workflow runs "
+            "data-parallel only" % n_stage)
+    workflow._parallel_style_ = ("dp_pp", data_axis, stage_axis)
+    return workflow
+
+
 def rebuild_mesh(workflow, surviving_devices=None, axis="data",
                  requeue_in_flight=True):
     """Elastic recovery after chip loss (the mesh-granularity

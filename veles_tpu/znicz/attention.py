@@ -14,6 +14,8 @@ GradientDescentBase momentum rule updates every trainable, snapshots
 and the distributed contract come from ForwardBase.
 """
 
+import functools
+
 import numpy
 
 from ..memory import Vector
@@ -28,6 +30,40 @@ def _layer_norm(x, gamma, beta, eps=1e-5):
     var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
     return ((xf - mu) * jnp.reciprocal(jnp.sqrt(var + eps)) * gamma +
             beta).astype(x.dtype)
+
+
+def transformer_block_apply(params, x, n_heads, causal, cdt,
+                            attend=None, mlp=None):
+    """Pure pre-LN block: x + MHA(LN(x)), then + MLP(LN(·)).  Shared
+    by TransformerBlock.tforward, the MoE block (which passes its
+    expert FFN via ``mlp``), and the pipelined stack (the pipeline
+    stages must be a pure (params, x) → y function).  ``mlp``
+    receives the post-LN activations (B, S, E) and returns the FFN
+    output to be residual-added; None → the dense w1/w2 MLP."""
+    import jax.numpy as jnp
+    from ..ops import attention as A
+    B, S, E = x.shape
+
+    def dot(a, w, b):
+        return jnp.dot(a.astype(cdt), w.astype(cdt),
+                       preferred_element_type=jnp.float32) + b
+
+    h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
+    q = dot(h, params["wq"], params["bq"]).reshape(B, S, n_heads, -1)
+    k = dot(h, params["wk"], params["bk"]).reshape(B, S, n_heads, -1)
+    v = dot(h, params["wv"], params["bv"]).reshape(B, S, n_heads, -1)
+    if attend is None:
+        attend = functools.partial(A.attention, causal=causal)
+    attn = attend(q.astype(cdt), k.astype(cdt),
+                  v.astype(cdt)).reshape(B, S, E)
+    x = x + dot(attn, params["wo"], params["bo"])
+    h = _layer_norm(x, params["ln2_g"], params["ln2_b"])
+    if mlp is not None:
+        x = x + mlp(h)
+    else:
+        h = jnp.maximum(dot(h, params["w1"], params["b1"]), 0.0)
+        x = x + dot(h, params["w2"], params["b2"])
+    return x.astype(jnp.float32)
 
 
 class Embedding(ForwardBase):
@@ -154,27 +190,187 @@ class TransformerBlock(ForwardBase):
         return A.attention(q, k, v, causal=self.causal)
 
     def tforward(self, read, write, params, ctx, state=None):
+        x = read(self.input)
+        out = transformer_block_apply(
+            params, x, self.n_heads, self.causal,
+            self.compute_dtype,
+            attend=lambda q, k, v: self._attend(q, k, v))
+        write(self.output, out)
+
+
+class MoETransformerBlock(TransformerBlock):
+    """Transformer block whose MLP is a top-1 Mixture-of-Experts
+    (ops/moe.py — GShard dispatch/combine einsums).  Expert parameters
+    carry a leading ``n_experts`` dimension; under a mesh with an
+    ``expert`` axis (apply_dp_ep_sharding) that dimension shards there
+    and XLA lowers the dispatch einsums to all-to-alls over ICI.
+
+    kwargs beyond TransformerBlock: ``n_experts``;
+    ``capacity_factor`` (default 1.25); ``aux_weight`` — load-balance
+    loss weight (default 0.01); ``expert_axis`` — recorded so the
+    sharding helper can find MoE blocks.
+    """
+
+    MAPPING = "moe_transformer_block"
+
+    PARAM_NAMES = ("ln1_g", "ln1_b", "wq", "wk", "wv", "wo",
+                   "bq", "bk", "bv", "bo",
+                   "ln2_g", "ln2_b", "router",
+                   "w1", "b1", "w2", "b2")
+
+    def __init__(self, workflow, **kwargs):
+        self.n_experts = kwargs.get("n_experts", 4)
+        self.capacity_factor = kwargs.get("capacity_factor", 1.25)
+        self.aux_weight = kwargs.get("aux_weight", 0.01)
+        self.expert_axis = kwargs.get("expert_axis")
+        super(MoETransformerBlock, self).__init__(workflow, **kwargs)
+
+    def initialize(self, device=None, **kwargs):
+        batch, seq, embed = self.input.shape
+        hidden = embed * self.mlp_ratio
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(embed))
+        E = self.n_experts
+        moe_shapes = {
+            "router": (embed, E),
+            "w1": (E, embed, hidden), "b1": (E, hidden),
+            "w2": (E, hidden, embed), "b2": (E, embed),
+        }
+        for name, shape in moe_shapes.items():
+            vec = self.params[name]
+            if vec:
+                continue
+            arr = numpy.zeros(shape, dtype=numpy.float32)
+            if name in ("router", "w1", "w2"):
+                self.rand().fill_normal(arr, stddev=stddev)
+            vec.mem = arr
+            vec.initialize(self.device)
+        super(MoETransformerBlock, self).initialize(device=device,
+                                                    **kwargs)
+
+    @property
+    def expert_params(self):
+        """The expert-stacked Vectors (leading n_experts dim) — what
+        apply_dp_ep_sharding shards."""
+        return {n: self.params[n] for n in ("w1", "b1", "w2", "b2")}
+
+    def tforward(self, read, write, params, ctx, state=None):
         import jax.numpy as jnp
+        from ..ops.moe import moe_ffn
         x = read(self.input)
         B, S, E = x.shape
-        H = self.n_heads
+
+        def mlp(h):
+            y, aux, load = moe_ffn(
+                h.reshape(B * S, E), params["router"], params["w1"],
+                params["b1"], params["w2"], params["b2"],
+                capacity_factor=self.capacity_factor)
+            ctx.add_aux_loss(self.aux_weight * aux)
+            ctx.add_metric("%s_max_expert_load" % self.name,
+                           load.max() / jnp.maximum(load.sum(), 1.0))
+            return y.reshape(B, S, E)
+
+        out = transformer_block_apply(
+            params, x, self.n_heads, self.causal,
+            self.compute_dtype,
+            attend=lambda q, k, v: self._attend(q, k, v), mlp=mlp)
+        write(self.output, out)
+
+
+class PipelinedTransformerStack(ForwardBase):
+    """N homogeneous transformer blocks as ONE unit with stage-
+    stacked parameters (leading ``n_blocks`` dim) — the pipeline-
+    parallel formulation (ops/pipeline.py ``gpipe``): under a mesh
+    with a ``stage`` axis the stack shards one block per device, the
+    minibatch splits into ``n_microbatches``, and activations hand
+    off stage-to-stage via ppermute.  Without the mesh axis the same
+    stacked parameters run as a plain ``lax.scan`` — bit-identical
+    math, so pipelined vs sequential parity is testable.
+    """
+
+    MAPPING = "pipelined_transformer_stack"
+
+    def __init__(self, workflow, **kwargs):
+        super(PipelinedTransformerStack, self).__init__(workflow,
+                                                        **kwargs)
+        self.n_blocks = kwargs.get("n_blocks", 4)
+        self.n_heads = kwargs.get("n_heads", 4)
+        self.mlp_ratio = kwargs.get("mlp_ratio", 4)
+        self.causal = kwargs.get("causal", True)
+        self.stage_axis = kwargs.get("stage_axis")
+        self.n_microbatches = kwargs.get("n_microbatches", 4)
+        self.params = {name: Vector()
+                       for name in TransformerBlock.PARAM_NAMES}
+
+    @property
+    def trainables(self):
+        return {n: v for n, v in self.params.items() if v}
+
+    def initialize(self, device=None, **kwargs):
+        super(PipelinedTransformerStack, self).initialize(
+            device=device, **kwargs)
+        batch, seq, embed = self.input.shape
+        if embed % self.n_heads:
+            raise ValueError("embed dim %d not divisible by %d heads"
+                             % (embed, self.n_heads))
+        hidden = embed * self.mlp_ratio
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(embed))
+        shapes = {
+            "ln1_g": (embed,), "ln1_b": (embed,),
+            "wq": (embed, embed), "wk": (embed, embed),
+            "wv": (embed, embed), "wo": (embed, embed),
+            "bq": (embed,), "bk": (embed,), "bv": (embed,),
+            "bo": (embed,),
+            "ln2_g": (embed,), "ln2_b": (embed,),
+            "w1": (embed, hidden), "b1": (hidden,),
+            "w2": (hidden, embed), "b2": (embed,),
+        }
+        for name, shape in shapes.items():
+            vec = self.params[name]
+            if vec:
+                continue
+            arr = numpy.zeros((self.n_blocks,) + shape,
+                              dtype=numpy.float32)
+            if name.startswith("w"):
+                self.rand().fill_normal(arr, stddev=stddev)
+            elif name.endswith("_g"):
+                arr[...] = 1.0
+            vec.mem = arr
+            vec.initialize(self.device)
+        self.output.mem = numpy.zeros((batch, seq, embed),
+                                      dtype=numpy.float32)
+        self.output.initialize(self.device)
+
+    @property
+    def stage_params(self):
+        """The stage-stacked Vectors — what a pipeline sharding
+        helper shards on the stage axis (leading dim)."""
+        return dict(self.trainables)
+
+    def tforward(self, read, write, params, ctx, state=None):
+        from ..ops import pipeline as PL
+        x = read(self.input)
         cdt = self.compute_dtype
 
-        def dot(a, w, b):
-            return jnp.dot(a.astype(cdt), w.astype(cdt),
-                           preferred_element_type=jnp.float32) + b
+        def block_fn(p, h):
+            return transformer_block_apply(p, h, self.n_heads,
+                                           self.causal, cdt)
 
-        h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
-        q = dot(h, params["wq"], params["bq"]).reshape(B, S, H, -1)
-        k = dot(h, params["wk"], params["bk"]).reshape(B, S, H, -1)
-        v = dot(h, params["wv"], params["bv"]).reshape(B, S, H, -1)
-        attn = self._attend(q.astype(cdt), k.astype(cdt),
-                            v.astype(cdt)).reshape(B, S, E)
-        x = x + dot(attn, params["wo"], params["bo"])
-        h = _layer_norm(x, params["ln2_g"], params["ln2_b"])
-        h = jnp.maximum(dot(h, params["w1"], params["b1"]), 0.0)
-        x = x + dot(h, params["w2"], params["b2"])
-        write(self.output, x.astype(jnp.float32))
+        mesh = getattr(self.workflow, "mesh", None)
+        if self.stage_axis and mesh is not None and \
+                self.stage_axis in mesh.axis_names and \
+                self.n_blocks % mesh.shape[self.stage_axis] == 0:
+            # Mirrors apply_dp_pp_sharding's divisibility contract:
+            # an indivisible stack stays replicated and runs the
+            # sequential scan instead of crashing inside shard_map.
+            out = PL.gpipe(block_fn, params, x, mesh,
+                           self.stage_axis, self.n_microbatches)
+        else:
+            out = PL.sequential_stack(block_fn, params, x)
+        write(self.output, out)
+
+
+class GDPipelinedStack(GradientDescentBase):
+    MAPPING = "pipelined_transformer_stack"
 
 
 class LMHead(ForwardBase):
@@ -275,6 +471,10 @@ class GDEmbedding(GradientDescentBase):
 
 class GDTransformerBlock(GradientDescentBase):
     MAPPING = "transformer_block"
+
+
+class GDMoETransformerBlock(GradientDescentBase):
+    MAPPING = "moe_transformer_block"
 
 
 class GDLMHead(GradientDescentBase):

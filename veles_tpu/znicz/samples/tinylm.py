@@ -23,7 +23,10 @@ from ...loader.fullbatch import FullBatchLoader
 from ...plumbing import Repeater
 from ...accelerated_units import AcceleratedWorkflow
 from ..attention import (Embedding, EvaluatorLM, GDEmbedding,
-                         GDLMHead, GDTransformerBlock, LMHead,
+                         GDLMHead, GDMoETransformerBlock,
+                         GDPipelinedStack, GDTransformerBlock,
+                         LMHead, MoETransformerBlock,
+                         PipelinedTransformerStack,
                          TransformerBlock)
 from ..decision import DecisionGD
 
@@ -58,6 +61,8 @@ class TinyLMWorkflow(AcceleratedWorkflow):
                  embed_dim=32, n_heads=4, n_blocks=1,
                  minibatch_size=64, learning_rate=0.01,
                  gradient_moment=0.9, max_epochs=8, seq_axis=None,
+                 n_experts=0, expert_axis=None, pipelined=False,
+                 stage_axis=None, n_microbatches=4,
                  loader_cls=FirstTokenLoader, loader_config=None,
                  **kwargs):
         super(TinyLMWorkflow, self).__init__(workflow, **kwargs)
@@ -78,10 +83,30 @@ class TinyLMWorkflow(AcceleratedWorkflow):
 
         self.forwards = [self.embedding]
         prev = self.embedding
+        if pipelined and n_experts:
+            raise ValueError(
+                "pipelined=True with n_experts>0 is not supported — "
+                "the pipelined stack holds dense blocks only")
+        if pipelined:
+            stack = PipelinedTransformerStack(
+                self, n_blocks=n_blocks, n_heads=n_heads,
+                causal=True, stage_axis=stage_axis,
+                n_microbatches=n_microbatches, name="stack")
+            stack.link_from(prev)
+            stack.input = prev.output
+            self.forwards.append(stack)
+            prev = stack
+            n_blocks = 0
         for i in range(n_blocks):
-            block = TransformerBlock(
-                self, n_heads=n_heads, causal=True,
-                seq_axis=seq_axis, name="block%d" % i)
+            if n_experts:
+                block = MoETransformerBlock(
+                    self, n_heads=n_heads, causal=True,
+                    seq_axis=seq_axis, n_experts=n_experts,
+                    expert_axis=expert_axis, name="block%d" % i)
+            else:
+                block = TransformerBlock(
+                    self, n_heads=n_heads, causal=True,
+                    seq_axis=seq_axis, name="block%d" % i)
             block.link_from(prev)
             block.input = prev.output
             self.forwards.append(block)
@@ -115,6 +140,8 @@ class TinyLMWorkflow(AcceleratedWorkflow):
         for unit in reversed(self.forwards):
             cls = {Embedding: GDEmbedding,
                    TransformerBlock: GDTransformerBlock,
+                   MoETransformerBlock: GDMoETransformerBlock,
+                   PipelinedTransformerStack: GDPipelinedStack,
                    LMHead: GDLMHead}[type(unit)]
             gd = cls(self, target=unit, **gd_kw)
             gd.link_from(prev_gd)
