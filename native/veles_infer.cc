@@ -17,6 +17,7 @@
 
 #include <zlib.h>
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -149,23 +150,30 @@ void apply_act(Act act, float *v, int n, int row_len) {
 
 /* ---- per-unit Execute (reference: unit.h:41) ------------------------ */
 
+/* y[rows,out] = x[rows,in] @ w[in,out] + b — the one GEMM kernel
+ * shared by dense units and the transformer block. */
+void matmul_bias(const float *x, const float *w, const float *b,
+                 float *y, int rows, int in, int out) {
+  for (int r = 0; r < rows; ++r) {
+    float *yr = y + (size_t)r * out;
+    for (int j = 0; j < out; ++j) yr[j] = b ? b[j] : 0.0f;
+    const float *xr = x + (size_t)r * in;
+    for (int i = 0; i < in; ++i) {
+      const float xi = xr[i];
+      if (xi == 0.0f) continue;
+      const float *wr = w + (size_t)i * out;
+      for (int j = 0; j < out; ++j) yr[j] += xi * wr[j];
+    }
+  }
+}
+
 void run_dense(const UnitDesc &u, const float *in, float *out,
                int batch, int fan_in, int n_out) {
-  const Param &w = u.params.at("weights");
   const float *b = nullptr;
   auto bit = u.params.find("bias");
   if (bit != u.params.end()) b = bit->second.data.data();
-  for (int s = 0; s < batch; ++s) {
-    const float *x = in + s * fan_in;
-    float *y = out + s * n_out;
-    for (int j = 0; j < n_out; ++j) y[j] = b ? b[j] : 0.0f;
-    for (int i = 0; i < fan_in; ++i) {
-      const float xi = x[i];
-      if (xi == 0.0f) continue;
-      const float *wr = w.data.data() + i * n_out;
-      for (int j = 0; j < n_out; ++j) y[j] += xi * wr[j];
-    }
-  }
+  matmul_bias(in, u.params.at("weights").data.data(), b, out,
+              batch, fan_in, n_out);
   apply_act(act_of(u.type), out, batch * n_out, n_out);
 }
 
@@ -294,6 +302,114 @@ void run_kohonen(const UnitDesc &u, const float *in, float *out,
       }
       y[j] = (float)d;
     }
+  }
+}
+
+/* ---- transformer family (no reference counterpart; mirrors
+ * ExportedModel._transformer_numpy / znicz/attention.py) ----------- */
+
+void run_embedding(const UnitDesc &u, const float *in, float *out,
+                   int batch, int seq, int embed) {
+  const Param &w = u.params.at("weights");
+  const Param &pos = u.params.at("pos");
+  const int vocab = (int)w.dims[0];
+  for (int s = 0; s < batch; ++s)
+    for (int t = 0; t < seq; ++t) {
+      int tok = (int)in[s * seq + t];
+      if (tok < 0) tok = 0;
+      if (tok >= vocab) tok = vocab - 1;
+      const float *we = w.data.data() + (size_t)tok * embed;
+      const float *pe = pos.data.data() + (size_t)t * embed;
+      float *y = out + ((size_t)s * seq + t) * embed;
+      for (int e = 0; e < embed; ++e) y[e] = we[e] + pe[e];
+    }
+}
+
+void layer_norm(const float *x, const float *g, const float *b,
+                float *y, int n, float eps = 1e-5f) {
+  double mu = 0.0;
+  for (int i = 0; i < n; ++i) mu += x[i];
+  mu /= n;
+  double var = 0.0;
+  for (int i = 0; i < n; ++i) var += (x[i] - mu) * (x[i] - mu);
+  var /= n;
+  const float r = 1.0f / std::sqrt((float)var + eps);
+  for (int i = 0; i < n; ++i)
+    y[i] = ((float)(x[i] - mu)) * r * g[i] + b[i];
+}
+
+void run_transformer_block(const UnitDesc &u, const float *in,
+                           float *out, int batch, int seq,
+                           int embed) {
+  const int H = (int)u.cfgv("n_heads", 1);
+  const bool causal = u.cfgv("causal", 1.0) != 0.0;
+  const int D = embed / H;
+  const float scale = 1.0f / std::sqrt((float)D);
+  auto P = [&](const char *n) {
+    return u.params.at(n).data.data();
+  };
+  std::vector<float> h((size_t)seq * embed), q(h.size()),
+      k(h.size()), v(h.size()), attn(h.size()),
+      ln2((size_t)seq * embed), scores((size_t)seq);
+  const int hidden = (int)u.params.at("w1").dims[1];
+  std::vector<float> mlp((size_t)seq * hidden);
+  for (int s = 0; s < batch; ++s) {
+    const float *x = in + (size_t)s * seq * embed;
+    float *y = out + (size_t)s * seq * embed;
+    /* pre-LN attention */
+    for (int t = 0; t < seq; ++t)
+      layer_norm(x + (size_t)t * embed, P("ln1_g"), P("ln1_b"),
+                 h.data() + (size_t)t * embed, embed);
+    matmul_bias(h.data(), P("wq"), P("bq"), q.data(), seq, embed,
+                embed);
+    matmul_bias(h.data(), P("wk"), P("bk"), k.data(), seq, embed,
+                embed);
+    matmul_bias(h.data(), P("wv"), P("bv"), v.data(), seq, embed,
+                embed);
+    std::fill(attn.begin(), attn.end(), 0.0f);
+    for (int head = 0; head < H; ++head) {
+      const int off = head * D;
+      for (int i = 0; i < seq; ++i) {
+        const int lim = causal ? i + 1 : seq;
+        float mx = -1e30f;
+        for (int j = 0; j < lim; ++j) {
+          double dot = 0.0;
+          const float *qi = q.data() + (size_t)i * embed + off;
+          const float *kj = k.data() + (size_t)j * embed + off;
+          for (int d = 0; d < D; ++d) dot += (double)qi[d] * kj[d];
+          scores[j] = (float)dot * scale;
+          mx = std::max(mx, scores[j]);
+        }
+        double sum = 0.0;
+        for (int j = 0; j < lim; ++j) {
+          scores[j] = std::exp(scores[j] - mx);
+          sum += scores[j];
+        }
+        float *ai = attn.data() + (size_t)i * embed + off;
+        for (int j = 0; j < lim; ++j) {
+          const float p = (float)(scores[j] / sum);
+          const float *vj = v.data() + (size_t)j * embed + off;
+          for (int d = 0; d < D; ++d) ai[d] += p * vj[d];
+        }
+      }
+    }
+    /* x + attn @ wo + bo */
+    matmul_bias(attn.data(), P("wo"), P("bo"), h.data(), seq, embed,
+                embed);
+    for (size_t i = 0; i < (size_t)seq * embed; ++i)
+      h[i] += x[i];
+    /* pre-LN MLP with residual into y */
+    std::vector<float> &res = h;  /* x after attention residual */
+    for (int t = 0; t < seq; ++t)
+      layer_norm(res.data() + (size_t)t * embed, P("ln2_g"),
+                 P("ln2_b"), ln2.data() + (size_t)t * embed, embed);
+    matmul_bias(ln2.data(), P("w1"), P("b1"), mlp.data(), seq,
+                embed, hidden);
+    for (float &m : mlp) m = std::max(m, 0.0f);
+    matmul_bias(mlp.data(), P("w2"), P("b2"), y, seq, hidden,
+                embed);
+    for (size_t i = 0; i < (size_t)seq * embed; ++i)
+      y[i] += res[i];
   }
 }
 
@@ -433,6 +549,62 @@ bool infer_shapes(VtModel *m) {
         set_error("unit " + u.name + ": bad LRN window");
         return false;
       }
+    } else if (t == "embedding") {
+      const int seq = si.size();
+      const int embed = (int)u.cfgv("embed_dim");
+      const int vocab = (int)u.cfgv("vocab_size");
+      if (seq <= 0 || embed <= 0 || vocab <= 0) {
+        set_error("unit " + u.name + ": bad embedding geometry");
+        return false;
+      }
+      if (!checked_param(u, "weights", (size_t)vocab * embed))
+        return false;
+      auto pit = u.params.find("pos");
+      if (pit == u.params.end() || pit->second.dims.size() != 2 ||
+          (int)pit->second.dims[0] < seq ||
+          (int)pit->second.dims[1] != embed) {
+        set_error("unit " + u.name + ": positional table must be "
+                  "(>=seq, embed)");
+        return false;
+      }
+      so = Shape{seq, 1, embed, true};
+    } else if (t == "transformer_block") {
+      const int seq = si.h, embed = si.c;
+      const int heads = (int)u.cfgv("n_heads", 1);
+      if (si.w != 1 || seq <= 0 || embed <= 0 || heads <= 0 ||
+          embed % heads) {
+        set_error("unit " + u.name + ": bad transformer geometry");
+        return false;
+      }
+      auto w1it = u.params.find("w1");
+      if (w1it == u.params.end() || w1it->second.dims.size() != 2 ||
+          (int)w1it->second.dims[0] != embed) {
+        set_error("unit " + u.name + ": w1 must be (embed, hidden)");
+        return false;
+      }
+      const int hidden = (int)w1it->second.dims[1];
+      const size_t E = (size_t)embed;
+      const char *vecs_e[] = {"ln1_g", "ln1_b", "bq", "bk", "bv",
+                              "bo", "ln2_g", "ln2_b", "b2"};
+      for (const char *n : vecs_e)
+        if (!checked_param(u, n, E)) return false;
+      const char *mats_ee[] = {"wq", "wk", "wv", "wo"};
+      for (const char *n : mats_ee)
+        if (!checked_param(u, n, E * E)) return false;
+      if (!checked_param(u, "b1", (size_t)hidden) ||
+          !checked_param(u, "w2", (size_t)hidden * embed))
+        return false;
+      /* shape-preserving */
+    } else if (t == "lm_head") {
+      const int n_out = (int)u.cfgv("n_out");
+      if (si.w != 1 || n_out <= 0) {
+        set_error("unit " + u.name + ": bad lm_head geometry");
+        return false;
+      }
+      if (!checked_param(u, "weights", (size_t)si.c * n_out) ||
+          !check_optional_bias(u, (size_t)n_out))
+        return false;
+      so = Shape{si.h, 1, n_out, true};
     } else if (t == "mean_disp") {
       if (!checked_param(u, "mean", (size_t)si.size()) ||
           !checked_param(u, "rdisp", (size_t)si.size()))
@@ -649,6 +821,14 @@ int vt_forward(const VtModel *m, const float *input, int batch,
     } else if (t == "kohonen") {
       run_kohonen(u, a.data(), b.data(), batch, si.size(),
                   so.size());
+    } else if (t == "embedding") {
+      run_embedding(u, a.data(), b.data(), batch, si.size(), so.c);
+    } else if (t == "transformer_block") {
+      run_transformer_block(u, a.data(), b.data(), batch, si.h,
+                            si.c);
+    } else if (t == "lm_head") {
+      /* per-position dense: rows = batch × seq */
+      run_dense(u, a.data(), b.data(), batch * si.h, si.c, so.c);
     } else if (t.rfind("conv", 0) == 0) {
       run_conv(u, a.data(), b.data(), batch, si, so);
     } else if (t.find("pooling") != std::string::npos) {

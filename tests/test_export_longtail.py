@@ -131,3 +131,66 @@ def test_native_longtail_parity(rbm_artifact, ae_artifact,
         numpy.testing.assert_allclose(
             nat.forward(x), py.forward_numpy(x).reshape(8, -1),
             rtol=1e-4, atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def lm_artifact(tmp_path_factory):
+    from veles_tpu.znicz.samples.tinylm import TinyLMWorkflow
+    prng.reset()
+    prng.get(0).seed(3)
+    launcher = Launcher()
+    wf = TinyLMWorkflow(launcher, max_epochs=8)
+    launcher.initialize()
+    launcher.run()
+    path = str(tmp_path_factory.mktemp("lm") / "lm.veles.tgz")
+    export_workflow(wf, path)
+    return wf, path
+
+
+def test_lm_export_all_paths_agree(lm_artifact):
+    """Transformer LM artifact: numpy mirror == jitted jax chain ==
+    native C++ runtime, and the deployed model still solves its
+    task (first-token recall at 100%)."""
+    wf, path = lm_artifact
+    model = ExportedModel(path)
+    assert [u["type"] for u in model.units] == \
+        ["embedding", "transformer_block", "lm_head"]
+    assert model.manifest["input"]["dtype"] == "int32"
+    x = numpy.random.RandomState(0).randint(
+        0, 16, (6, 32)).astype(numpy.float32)
+    a = model.forward_numpy(x)
+    b = numpy.asarray(model.forward(x))
+    numpy.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
+    nat = NativeModel(path)
+    c = nat.forward(x)
+    numpy.testing.assert_allclose(c, a.reshape(6, -1), rtol=1e-4,
+                                  atol=1e-4)
+    pred = numpy.argmax(a, -1)
+    assert (pred == x[:, :1].astype(int)).mean() == 1.0
+
+
+def test_lm_export_ties_head_to_embedding(lm_artifact):
+    """The tied LM head materializes the embedding weights transposed
+    so the artifact stands alone."""
+    wf, path = lm_artifact
+    model = ExportedModel(path)
+    head = model.units[-1]
+    w = model.weights[head["params"]["weights"]]
+    wf.embedding.weights.map_read()
+    numpy.testing.assert_array_equal(
+        w, numpy.asarray(wf.embedding.weights.mem).T)
+
+
+def test_lm_export_clamps_oov_tokens(lm_artifact):
+    """Out-of-range token ids clamp identically in all three paths
+    (the numpy mirror must not raise/wrap where native/jax clamp)."""
+    _wf, path = lm_artifact
+    model = ExportedModel(path)
+    nat = NativeModel(path)
+    x = numpy.array([[99, -3] + [1] * 30], numpy.float32)
+    a = model.forward_numpy(x)
+    b = numpy.asarray(model.forward(x))
+    c = nat.forward(x)
+    numpy.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
+    numpy.testing.assert_allclose(c, a.reshape(1, -1), rtol=1e-4,
+                                  atol=1e-4)

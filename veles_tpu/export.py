@@ -60,6 +60,11 @@ EXPORTABLE = {
     "all2all_deconv": (), "all2all_deconv_sigmoid": (),
     "all2all_deconv_tanh": (),
     "kohonen": (),
+    # Transformer family (no reference counterpart — the TPU build's
+    # long-context extension, deployable like everything else).
+    "embedding": ("vocab_size", "embed_dim"),
+    "transformer_block": ("n_heads",),
+    "lm_head": (),
 }
 
 TANH_A, TANH_B = 1.7159, 0.6666
@@ -123,6 +128,34 @@ def _unit_entry(unit):
         params["weights"] = numpy.asarray(unit.weights.mem,
                                           dtype=numpy.float32)
         config["output_sample_shape"] = [int(unit.n_neurons)]
+    elif mapping == "embedding":
+        for pname, vec in unit.trainables.items():
+            vec.map_read()
+            params[pname] = numpy.asarray(vec.mem,
+                                          dtype=numpy.float32)
+    elif mapping == "transformer_block":
+        config["causal"] = int(unit.causal)
+        for pname, vec in unit.trainables.items():
+            vec.map_read()
+            params[pname] = numpy.asarray(vec.mem,
+                                          dtype=numpy.float32)
+    elif mapping == "lm_head":
+        # Tied heads materialize the embedding weights transposed so
+        # the artifact is standalone (same treatment as deconv).
+        if unit.tie_to is not None:
+            src = unit.tie_to.weights
+            src.map_read()
+            w = numpy.ascontiguousarray(
+                numpy.asarray(src.mem, dtype=numpy.float32).T)
+        else:
+            unit.weights.map_read()
+            w = numpy.asarray(unit.weights.mem, dtype=numpy.float32)
+        params["weights"] = w
+        if unit.include_bias and unit.bias:
+            unit.bias.map_read()
+            params["bias"] = numpy.asarray(unit.bias.mem,
+                                           dtype=numpy.float32)
+        config["output_sample_shape"] = [int(w.shape[1])]
     else:
         for pname, vec in getattr(unit, "trainables", {}).items():
             if not vec:
@@ -186,7 +219,9 @@ def export_workflow(workflow, path):
         "checksum": workflow.checksum,
         "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "input": {"sample_shape": list(in_vec.shape[1:]),
-                  "dtype": "float32"},
+                  # Token models declare int32; the wire format for
+                  # forward() inputs stays float (values are cast).
+                  "dtype": str(in_vec.dtype)},
         "output": {"sample_shape": list(out_vec.shape[1:])},
         "units": units,
     }
@@ -314,6 +349,23 @@ class ExportedModel(object):
             return y
         if t == "kohonen":
             return self._kohonen_numpy(entry, x)
+        if t == "embedding":
+            w = self._param(entry, "weights")
+            # Clamp OOV ids like the native runtime and jax indexing
+            # do — the mirror must not raise/wrap where they clamp.
+            tokens = numpy.clip(x.astype(numpy.int32), 0,
+                                w.shape[0] - 1)
+            return (w[tokens] +
+                    self._param(entry, "pos")[:tokens.shape[1]]
+                    ).astype(numpy.float32)
+        if t == "transformer_block":
+            return self._transformer_numpy(entry, x)
+        if t == "lm_head":
+            w = self._param(entry, "weights")
+            y = x @ w
+            if "bias" in entry["params"]:
+                y = y + self._param(entry, "bias")
+            return y.astype(numpy.float32)
         if t.startswith("conv"):
             return self._conv_numpy(entry, x)
         if t.endswith("pooling"):
@@ -321,6 +373,40 @@ class ExportedModel(object):
         if t == "norm":
             return self._lrn_numpy(cfg, x)
         raise Bug("unknown unit type %r in artifact" % t)
+
+    def _transformer_numpy(self, entry, x):
+        """Pre-LN block, numpy mirror of znicz/attention.py
+        ``transformer_block_apply``."""
+        cfg = entry["config"]
+        H = int(cfg["n_heads"])
+        causal = bool(cfg.get("causal", 1))
+        p = {n: self._param(entry, n) for n in entry["params"]}
+
+        def ln(v, g, b, eps=1e-5):
+            mu = v.mean(axis=-1, keepdims=True)
+            var = ((v - mu) ** 2).mean(axis=-1, keepdims=True)
+            return (v - mu) / numpy.sqrt(var + eps) * g + b
+
+        B, S, E = x.shape
+        D = E // H
+        h = ln(x, p["ln1_g"], p["ln1_b"])
+        q = (h @ p["wq"] + p["bq"]).reshape(B, S, H, D)
+        k = (h @ p["wk"] + p["bk"]).reshape(B, S, H, D)
+        v = (h @ p["wv"] + p["bv"]).reshape(B, S, H, D)
+        scores = numpy.einsum("bqhd,bkhd->bhqk", q, k) / \
+            numpy.sqrt(D)
+        if causal:
+            mask = numpy.tril(numpy.ones((S, S), bool))
+            scores = numpy.where(mask, scores, -1e30)
+        scores -= scores.max(axis=-1, keepdims=True)
+        pattn = numpy.exp(scores)
+        pattn /= pattn.sum(axis=-1, keepdims=True)
+        attn = numpy.einsum("bhqk,bkhd->bqhd", pattn, v) \
+            .reshape(B, S, E)
+        x = x + attn @ p["wo"] + p["bo"]
+        h = ln(x, p["ln2_g"], p["ln2_b"])
+        h = numpy.maximum(h @ p["w1"] + p["b1"], 0.0)
+        return (x + h @ p["w2"] + p["b2"]).astype(numpy.float32)
 
     def _kohonen_numpy(self, entry, x):
         # Squared distance to each SOM neuron (KohonenForward emits
@@ -459,6 +545,27 @@ class ExportedModel(object):
                 shape = cfg.get("output_sample_shape")
                 if shape:
                     x = x.reshape((x.shape[0],) + tuple(shape))
+            elif t == "embedding":
+                w = jnp.asarray(self._param(entry, "weights"))
+                # Explicit clamp: jnp indexing wraps negatives where
+                # the native runtime (and the numpy mirror) clamp.
+                tokens = jnp.clip(x.astype(jnp.int32), 0,
+                                  w.shape[0] - 1)
+                x = (w[tokens] +
+                     self._param(entry, "pos")[:tokens.shape[1]])
+            elif t == "transformer_block":
+                from .znicz.attention import transformer_block_apply
+                p = {n: self._param(entry, n)
+                     for n in entry["params"]}
+                x = transformer_block_apply(
+                    p, x, int(cfg["n_heads"]),
+                    bool(cfg.get("causal", 1)), jnp.float32)
+            elif t == "lm_head":
+                w = self._param(entry, "weights")
+                y = x @ w
+                if "bias" in entry["params"]:
+                    y = y + self._param(entry, "bias")
+                x = y
             elif t == "kohonen":
                 w = self._param(entry, "weights")
                 xf = x.reshape(x.shape[0], -1)
