@@ -17,6 +17,12 @@ computation per block of ticks), not JPEG decode.
 
 ``python bench.py --mlp`` runs the secondary MNIST784-MLP bench.
 
+``python bench.py --lm`` runs the transformer-LM bench (no reference
+counterpart — the reference predates attention): a GPT-small-ish
+causal LM (8 pre-LN blocks, embed 512, 8 heads, seq 512, vocab 8192)
+trained end-to-end through the same fused block step; reports
+tokens/s and MFU against the analytic 6·P + attention FLOP count.
+
 ``python bench.py --streamed`` runs AlexNet from a NON-resident
 dataset: the streamed loader (loader/stream.py) reads a disk-backed
 npy memmap, a host worker pool stages each block, and uploads
@@ -67,6 +73,24 @@ ALEXNET_N_VALID = 512
 #: BENCHNOTES.md.)  Used only for TFLOP/s / MFU diagnostics.
 ALEXNET_TRAIN_GFLOP_PER_IMG = 6.81
 TPU_V5E_PEAK_BF16_TFLOPS = 197.0
+
+# LM bench geometry (GPT-small-ish; attention path headline).
+LM_VOCAB = 8192
+LM_SEQ = 512
+LM_EMBED = 512
+LM_HEADS = 8
+LM_BLOCKS = 8
+LM_BATCH = 16
+LM_TICKS_PER_DISPATCH = 8
+LM_N_TRAIN = 2048
+LM_N_VALID = 128
+#: Analytic train cost per token: 6 FLOP/param over the 12·E²-per-
+#: block weights (fwd+bwd+update matmuls) + embeddings, plus the
+#: attention score/value matmuls 12·S·E per layer.
+LM_TRAIN_FLOP_PER_TOKEN = (
+    6.0 * (12 * LM_EMBED * LM_EMBED * LM_BLOCKS +
+           LM_VOCAB * LM_EMBED) +
+    12.0 * LM_SEQ * LM_EMBED * LM_BLOCKS)
 
 MLP_BATCH = 100
 MLP_TICKS_PER_DISPATCH = 120
@@ -123,6 +147,36 @@ def build_mlp():
                        minibatch_size=MLP_BATCH,
                        ticks_per_dispatch=MLP_TICKS_PER_DISPATCH,
                        max_epochs=1000, loader_cls=SyntheticMnist)
+    launcher.initialize()
+    return launcher, wf
+
+
+def build_lm():
+    import numpy
+    import veles_tpu.prng as prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.znicz.samples.tinylm import (FirstTokenLoader,
+                                                TinyLMWorkflow)
+
+    class SyntheticCorpus(FirstTokenLoader):
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            n = LM_N_TRAIN + LM_N_VALID
+            self.original_data.mem = rng.randint(
+                0, LM_VOCAB, (n, LM_SEQ)).astype(numpy.int32)
+            self.original_labels.mem = numpy.roll(
+                self.original_data.mem, -1, axis=1)
+            self.class_lengths = [0, LM_N_VALID, LM_N_TRAIN]
+
+    prng.reset()
+    prng.get(0).seed(42)
+    launcher = Launcher()
+    wf = TinyLMWorkflow(
+        launcher, vocab_size=LM_VOCAB, seq_len=LM_SEQ,
+        embed_dim=LM_EMBED, n_heads=LM_HEADS, n_blocks=LM_BLOCKS,
+        minibatch_size=LM_BATCH,
+        ticks_per_dispatch=LM_TICKS_PER_DISPATCH,
+        max_epochs=1000, loader_cls=SyntheticCorpus)
     launcher.initialize()
     return launcher, wf
 
@@ -213,6 +267,21 @@ def main():
             "upload_gbps": round(bw / 1e9, 4),
             "bw_ceiling_images_per_sec": round(bw_ceiling, 1),
             "pipeline_efficiency": round(ips / bw_ceiling, 4),
+        }))
+        return
+    if "--lm" in sys.argv:
+        _, wf = build_lm()
+        ips = measure(wf, epochs=2)
+        tokens_per_sec = ips * LM_SEQ
+        tflops = tokens_per_sec * LM_TRAIN_FLOP_PER_TOKEN / 1e12
+        mfu = tflops / TPU_V5E_PEAK_BF16_TFLOPS
+        print(json.dumps({
+            "metric": "tinylm_gpt_small_train_tokens_per_sec",
+            "value": round(tokens_per_sec, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": round(mfu, 4),
+            "model_tflops_per_sec": round(tflops, 1),
+            "mfu_vs_v5e_bf16_peak": round(mfu, 4),
         }))
         return
     if "--mlp" in sys.argv:
