@@ -310,3 +310,38 @@ def test_tinylm_rejects_pipelined_moe():
     from veles_tpu.znicz.samples.tinylm import TinyLMWorkflow
     with pytest.raises(ValueError):
         TinyLMWorkflow(Launcher(), pipelined=True, n_experts=4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(causal):
+    """All-to-all (Ulysses) sequence parallelism == full attention —
+    the second sp strategy (two collectives vs the ring's N steps)."""
+    from veles_tpu.ops.attention import attention, \
+        sequence_parallel_attention
+    q, k, v = _qkv(H=8)
+    mesh = make_mesh(axes={"seq": 8})
+    full = attention(q, k, v, causal=causal)
+    uly = sequence_parallel_attention(q, k, v, mesh, "seq",
+                                      causal=causal, mode="ulysses")
+    numpy.testing.assert_allclose(full, numpy.asarray(uly),
+                                  rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    import jax.numpy as jnp
+    from veles_tpu.ops.attention import sequence_parallel_attention
+    q, k, v = _qkv(H=4)  # 4 heads over 8 devices
+    mesh = make_mesh(axes={"seq": 8})
+    with pytest.raises(ValueError, match="divisible"):
+        sequence_parallel_attention(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), mesh, "seq",
+                                    mode="ulysses")
+
+
+def test_tinylm_ulysses_training():
+    """dp(2) × sp(4) with the Ulysses strategy trains to the gate."""
+    launcher, wf = _train_tinylm(seq_axis="seq", sp_mode="ulysses")
+    mesh = make_mesh(axes={"data": 2, "seq": 4})
+    apply_dp_sp_sharding(wf, mesh)
+    launcher.run()
+    assert wf.decision.min_validation_err < 0.05

@@ -146,15 +146,52 @@ def ring_attention(q, k, v, axis_name, causal=False):
     return _finish(acc, l, q.dtype)
 
 
+def ulysses_attention(q, k, v, axis_name, causal=False):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style),
+    INSIDE ``shard_map``: each device holds a (B, S/N, H, D) sequence
+    shard; one all-to-all re-shards to (B, S, H/N, D) — full sequence,
+    a subset of heads — so plain full attention runs locally, then the
+    reverse all-to-all restores sequence sharding.  Two collectives
+    total per call (vs N ppermute steps for the ring); requires
+    H % N == 0.  Complements the ring: Ulysses moves activations
+    twice and computes dense attention, the ring streams k/v blocks —
+    which wins depends on S, H, and the interconnect.
+    """
+    n = lax.psum(1, axis_name)
+    B, Sq, H, D = q.shape
+    if H % n:
+        raise ValueError("ulysses needs heads (%d) divisible by the "
+                         "sequence-axis size (%d)" % (H, n))
+
+    def to_heads(x):
+        # (B, S/N, H, D) → (B, S, H/N, D): head-chunk i goes to
+        # device i, which receives every device's sequence shard.
+        return lax.all_to_all(x, axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+    def to_seq(x):
+        # Exact inverse: sequence chunks scatter back, head chunks
+        # reassemble in device order.
+        return lax.all_to_all(x, axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+    out = attention(to_heads(q), to_heads(k), to_heads(v),
+                    causal=causal)
+    return to_seq(out)
+
+
 def sequence_parallel_attention(q, k, v, mesh, seq_axis,
-                                causal=False, batch_axis=None):
-    """Wraps :func:`ring_attention` in ``shard_map`` over the mesh's
-    sequence axis (activations (B, S, H, D) sharded on dim 1), usable
-    from inside an outer jit: GSPMD reshards the operands to the
-    in_specs, the ring runs explicit ppermutes over ICI, and the
-    result comes back sequence-sharded.  ``batch_axis`` keeps the
-    batch dim data-parallel inside the shard_map (dp × sp composes:
-    the ring psums only over ``seq_axis``)."""
+                                causal=False, batch_axis=None,
+                                mode="ring"):
+    """Wraps a sequence-parallel attention (``mode``: "ring" →
+    :func:`ring_attention`, "ulysses" → :func:`ulysses_attention`) in
+    ``shard_map`` over the mesh's sequence axis (activations
+    (B, S, H, D) sharded on dim 1), usable from inside an outer jit:
+    GSPMD reshards the operands to the in_specs, the collectives run
+    over ICI, and the result comes back sequence-sharded.
+    ``batch_axis`` keeps the batch dim data-parallel inside the
+    shard_map (dp × sp composes: the collectives involve only
+    ``seq_axis``)."""
     import inspect
     try:
         from jax import shard_map
@@ -169,9 +206,13 @@ def sequence_parallel_attention(q, k, v, mesh, seq_axis,
     if batch_axis is not None and batch_axis not in mesh.axis_names:
         batch_axis = None
     spec = P(batch_axis, seq_axis, None, None)
+    modes = {"ring": ring_attention, "ulysses": ulysses_attention}
+    if mode not in modes:
+        raise ValueError("unknown sequence-parallel mode %r — "
+                         "valid: %s" % (mode, sorted(modes)))
+    inner = modes[mode]
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis,
-                          causal=causal),
+        functools.partial(inner, axis_name=seq_axis, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         **_kw)
     return fn(q, k, v)

@@ -138,6 +138,12 @@ class TransformerBlock(ForwardBase):
         self.mlp_ratio = kwargs.get("mlp_ratio", 4)
         self.causal = kwargs.get("causal", True)
         self.seq_axis = kwargs.get("seq_axis")
+        #: "ring" (ppermute k/v streaming, O(S/N) memory) or
+        #: "ulysses" (two all-to-alls, dense local attention).
+        self.sp_mode = kwargs.get("sp_mode", "ring")
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError("unknown sp_mode %r — valid: "
+                             "['ring', 'ulysses']" % (self.sp_mode,))
         self.batch_axis = kwargs.get("batch_axis", "data")
         self.params = {name: Vector() for name in self.PARAM_NAMES}
 
@@ -186,7 +192,7 @@ class TransformerBlock(ForwardBase):
                 self.seq_axis in mesh.axis_names:
             return A.sequence_parallel_attention(
                 q, k, v, mesh, self.seq_axis, causal=self.causal,
-                batch_axis=self.batch_axis)
+                batch_axis=self.batch_axis, mode=self.sp_mode)
         return A.attention(q, k, v, causal=self.causal)
 
     def tforward(self, read, write, params, ctx, state=None):

@@ -61,6 +61,7 @@ class TinyLMWorkflow(AcceleratedWorkflow):
                  embed_dim=32, n_heads=4, n_blocks=1,
                  minibatch_size=64, learning_rate=0.01,
                  gradient_moment=0.9, max_epochs=8, seq_axis=None,
+                 sp_mode="ring",
                  n_experts=0, expert_axis=None, pipelined=False,
                  stage_axis=None, n_microbatches=4,
                  loader_cls=FirstTokenLoader, loader_config=None,
@@ -101,12 +102,14 @@ class TinyLMWorkflow(AcceleratedWorkflow):
             if n_experts:
                 block = MoETransformerBlock(
                     self, n_heads=n_heads, causal=True,
-                    seq_axis=seq_axis, n_experts=n_experts,
+                    seq_axis=seq_axis, sp_mode=sp_mode,
+                    n_experts=n_experts,
                     expert_axis=expert_axis, name="block%d" % i)
             else:
                 block = TransformerBlock(
                     self, n_heads=n_heads, causal=True,
-                    seq_axis=seq_axis, name="block%d" % i)
+                    seq_axis=seq_axis, sp_mode=sp_mode,
+                    name="block%d" % i)
             block.link_from(prev)
             block.input = prev.output
             self.forwards.append(block)
