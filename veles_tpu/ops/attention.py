@@ -28,6 +28,10 @@ from jax import lax
 
 NEG_INF = -1e30
 
+#: Valid sequence-parallel strategies (single source of truth for
+#: sequence_parallel_attention and the unit-level validation).
+SP_MODES = ("ring", "ulysses")
+
 
 def _block_update(acc, m, l, q, k, v, *, scale, mask=None):
     """One streaming-softmax update: fold the (q·kᵀ) scores of a
@@ -175,8 +179,15 @@ def ulysses_attention(q, k, v, axis_name, causal=False):
         return lax.all_to_all(x, axis_name, split_axis=1,
                               concat_axis=2, tiled=True)
 
-    out = attention(to_heads(q), to_heads(k), to_heads(v),
-                    causal=causal)
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    S = qh.shape[1]
+    # The gathered sequence is full-length: O(S²) scores would defeat
+    # the long-context purpose, so stream blockwise once S is big.
+    if S > 1024 and S % 512 == 0:
+        out = blockwise_attention(qh, kh, vh, block_size=512,
+                                  causal=causal)
+    else:
+        out = attention(qh, kh, vh, causal=causal)
     return to_seq(out)
 
 
@@ -207,6 +218,7 @@ def sequence_parallel_attention(q, k, v, mesh, seq_axis,
         batch_axis = None
     spec = P(batch_axis, seq_axis, None, None)
     modes = {"ring": ring_attention, "ulysses": ulysses_attention}
+    assert set(modes) == set(SP_MODES)
     if mode not in modes:
         raise ValueError("unknown sequence-parallel mode %r — "
                          "valid: %s" % (mode, sorted(modes)))

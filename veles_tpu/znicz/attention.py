@@ -141,9 +141,10 @@ class TransformerBlock(ForwardBase):
         #: "ring" (ppermute k/v streaming, O(S/N) memory) or
         #: "ulysses" (two all-to-alls, dense local attention).
         self.sp_mode = kwargs.get("sp_mode", "ring")
-        if self.sp_mode not in ("ring", "ulysses"):
-            raise ValueError("unknown sp_mode %r — valid: "
-                             "['ring', 'ulysses']" % (self.sp_mode,))
+        from ..ops.attention import SP_MODES
+        if self.sp_mode not in SP_MODES:
+            raise ValueError("unknown sp_mode %r — valid: %s" %
+                             (self.sp_mode, list(SP_MODES)))
         self.batch_axis = kwargs.get("batch_axis", "data")
         self.params = {name: Vector() for name in self.PARAM_NAMES}
 

@@ -95,6 +95,15 @@ class StandardWorkflow(AcceleratedWorkflow):
             ev.input = last.output
             ev.target = self.loader.minibatch_targets
             ev.fallback_target = self.loader.minibatch_data
+        elif self.loss_function == "lm":
+            # Next-token cross-entropy over (B, S, V) logits — the
+            # declarative path to transformer LMs ({"type":
+            # "embedding"} / {"type": "transformer_block"} /
+            # {"type": "lm_head"} layer configs).
+            from .attention import EvaluatorLM
+            ev = EvaluatorLM(self)
+            ev.input = last.output
+            ev.labels = self.loader.minibatch_labels
         else:
             raise ValueError("unknown loss_function %r" %
                              self.loss_function)
