@@ -108,20 +108,27 @@ def test_tinylm_sequence_parallel_training():
     assert wf.decision.min_validation_err < 0.05
 
 
-def test_tinylm_snapshot_roundtrip(tmp_path):
-    """Transformer workflows pickle/resume like every other workflow
-    (params ride Vectors; the ring is rebuilt from config)."""
+@pytest.mark.parametrize("variant,kwargs,param,lead", [
+    ("dense", {}, "wq", None),
+    ("moe", {"n_experts": 4}, "w1", 4),
+    ("pipelined", {"pipelined": True, "n_blocks": 4}, "w1", 4),
+])
+def test_lm_snapshot_roundtrip(variant, kwargs, param, lead):
+    """Every transformer variant pickles/resumes like every other
+    workflow (params — incl. expert/stage-stacked — ride Vectors;
+    the ring/pipeline is rebuilt from config)."""
     import pickle
-    launcher, wf = _train_tinylm()
+    launcher, wf = _train_tinylm(max_epochs=2, **kwargs)
     launcher.run()
-    blob = pickle.dumps(wf)
-    wf2 = pickle.loads(blob)
-    b0 = wf.forwards[1].params["wq"]
-    b0.map_read()
-    w1 = numpy.array(b0.mem)
-    b2 = wf2.forwards[1].params["wq"]
-    b2.map_read()
-    numpy.testing.assert_array_equal(w1, numpy.array(b2.mem))
+    wf2 = pickle.loads(pickle.dumps(wf))
+    a = wf.forwards[1].params[param]
+    a.map_read()
+    b = wf2.forwards[1].params[param]
+    b.map_read()
+    numpy.testing.assert_array_equal(numpy.array(a.mem),
+                                     numpy.array(b.mem))
+    if lead is not None:
+        assert b.shape[0] == lead  # expert/stage stacking survived
 
 
 # -- expert parallelism (MoE) -------------------------------------------
@@ -388,3 +395,64 @@ def test_ring_long_sequence_smoke():
     assert numpy.isfinite(ring).all()
     full = numpy.asarray(attention(q, k, v, causal=True))
     numpy.testing.assert_allclose(ring, full, rtol=5e-5, atol=5e-5)
+
+
+@pytest.mark.parametrize("variant", ["moe", "pipelined"])
+def test_lm_variant_snapshot_roundtrip(variant):
+    """MoE and pipelined LM variants pickle/resume like every other
+    workflow (expert/stage-stacked params ride Vectors)."""
+    import pickle
+    kwargs = {"n_experts": 4} if variant == "moe" else \
+        {"pipelined": True, "n_blocks": 4}
+    launcher, wf = _train_tinylm(max_epochs=2, **kwargs)
+    launcher.run()
+    wf2 = pickle.loads(pickle.dumps(wf))
+    name = "w1"
+    a = wf.forwards[1].params[name]
+    a.map_read()
+    b = wf2.forwards[1].params[name]
+    b.map_read()
+    numpy.testing.assert_array_equal(numpy.array(a.mem),
+                                     numpy.array(b.mem))
+    assert b.shape[0] == 4  # expert/stage stacking survived
+
+
+def test_vmapped_ga_composes_with_transformer(tmp_path,
+                                               monkeypatch):
+    """The vmapped genetics path trains a whole LM population in one
+    compiled program (EvaluatorLM's epoch accumulators feed fitness
+    exactly like the conv/FC evaluators)."""
+    import json
+    import os
+    from veles_tpu.__main__ import Main
+    import veles_tpu.genetics.optimizer as optimizer_mod
+    from veles_tpu.genetics.vmap_eval import PopulationEvaluator
+    engaged = []
+
+    class Recording(PopulationEvaluator):
+        def evaluate(self, genes, epochs=None):
+            engaged.append(len(genes))
+            return super(Recording, self).evaluate(genes, epochs)
+
+    # _make_vmap_evaluator silently falls back on Bug — the test must
+    # fail if the vmapped path stops engaging for transformer models.
+    monkeypatch.setattr(optimizer_mod, "PopulationEvaluator",
+                        Recording, raising=False)
+    import veles_tpu.genetics.vmap_eval as vmap_mod
+    monkeypatch.setattr(vmap_mod, "PopulationEvaluator", Recording)
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = tmp_path / "ga.json"
+    prng.reset()
+    rc = Main([os.path.join(REPO, "veles_tpu", "znicz", "samples",
+                            "tinylm.py"),
+               "root.tinylm.max_epochs=4",
+               "root.tinylm.learning_rate=Tune(0.001, 0.0005, 0.1)",
+               "--optimize", "4:2",
+               "--result-file", str(result),
+               "--random-seed", "11", "-v", "warning"]).run()
+    assert rc == 0
+    data = json.loads(result.read_text())
+    assert data["generations"] == 2
+    assert engaged and sum(engaged) >= 4  # vmapped path really ran
+    # GA must find an lr that learns recall within 4 epochs.
+    assert data["best_fitness"] > 0.8
