@@ -338,3 +338,36 @@ def test_rotation_guards_mse_and_streamed(image_tree, tmp_path):
         StreamedFileImageLoader(
             wf, train_paths=[str(image_tree / "train")],
             rotations=(0.0, math.pi / 2))
+
+
+def test_sequence_labels_validated_not_balance_warned(caplog):
+    """Per-token (N, S) labels keep the LOUD unseen-label validation
+    (flattened) but skip class-balance warnings — token frequency
+    skew is language statistics, not a dataset bug."""
+    import logging
+    from veles_tpu.error import BadFormatError
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+
+    class SeqLoader(FullBatchLoader):
+        BAD = False
+
+        def load_data(self):
+            toks = numpy.zeros((64, 8), numpy.int32)
+            labels = numpy.zeros((64, 8), numpy.int32)
+            labels[:, 0] = 3  # skewed token frequencies
+            if self.BAD:
+                labels[:16] = 99  # valid tokens unseen in training
+            self.original_data.mem = toks
+            self.original_labels.mem = labels
+            self.class_lengths = [0, 16, 48]
+
+    good = SeqLoader(DummyWorkflow(), minibatch_size=16)
+    with caplog.at_level(logging.WARNING):
+        good.initialize()
+    assert not any("imbalanced" in r.message or
+                   "deviates" in r.message for r in caplog.records)
+
+    SeqLoader.BAD = True
+    bad = SeqLoader(DummyWorkflow(), minibatch_size=16)
+    with pytest.raises(BadFormatError, match="never seen"):
+        bad.initialize()

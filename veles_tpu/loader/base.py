@@ -226,6 +226,34 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         labels = self.dataset_labels()
         if labels is None:
             return
+        # Sequence models carry PER-TOKEN label arrays (N, S, ...):
+        # the dtype/range and unseen-label checks still apply (a
+        # vocab id in validation but not training is exactly the
+        # silent-bad-accuracy bug this function exists to catch) over
+        # the flattened tokens, but per-class BALANCE warnings are
+        # meaningless there and are suppressed.  Ragged per-sample
+        # label lists cannot be analyzed at all — skip with a notice.
+        sequence_labels = False
+        flat = []
+        for arr in labels:
+            if arr is None:
+                flat.append(None)
+                continue
+            try:
+                a = numpy.asarray(arr)
+            except ValueError:
+                self.info("ragged per-sample labels — dataset "
+                          "analysis skipped")
+                return
+            if a.dtype == object:
+                self.info("ragged per-sample labels — dataset "
+                          "analysis skipped")
+                return
+            if a.ndim > 1:
+                sequence_labels = True
+                a = a.ravel()
+            flat.append(a)
+        labels = flat
         self.label_stats = {}
         histograms = {}
         for cls, arr in enumerate(labels):
@@ -272,7 +300,11 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
                    "max %d (std %d)" % (CLASS_NAME[cls], len(hist),
                                         counts.min(), mean,
                                         counts.max(), std))
-            if std > mean / 2:
+            if sequence_labels:
+                # Token-frequency skew is normal language statistics,
+                # not a dataset bug — no imbalance warnings.
+                self.debug("%s (per-token)", msg)
+            elif std > mean / 2:
                 self.warning("%s — SEVERELY imbalanced", msg)
             elif std > mean / 10:
                 self.warning("%s — imbalanced", msg)
@@ -292,7 +324,7 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
                     abs(hist.get(lbl, 0) / total -
                         cnt / total_train)
                     for lbl, cnt in train_hist.items())
-                if drift > 0.1:
+                if drift > 0.1 and not sequence_labels:
                     self.warning(
                         "%s label distribution deviates from train "
                         "by up to %.0f%%", CLASS_NAME[cls],
