@@ -456,3 +456,30 @@ def test_vmapped_ga_composes_with_transformer(tmp_path,
     assert engaged and sum(engaged) >= 4  # vmapped path really ran
     # GA must find an lr that learns recall within 4 epochs.
     assert data["best_fitness"] > 0.8
+
+
+def test_lm_elastic_rebuild_on_chip_loss():
+    """Chip loss mid-LM-training: rebuild_mesh re-places the
+    transformer's params over the survivors, requeues in-flight work,
+    and training continues to the recall gate (the dp elastic story
+    extends to the attention family unchanged)."""
+    import jax
+    from veles_tpu.parallel import (apply_dp_sharding, make_mesh,
+                                    rebuild_mesh)
+    launcher, wf = _train_tinylm(max_epochs=3, minibatch_size=64)
+    mesh = make_mesh(jax.devices(), {"data": 8})
+    apply_dp_sharding(wf, mesh)
+    launcher._finished.clear()
+    wf.run()
+    mid_err = wf.decision.min_validation_err
+
+    survivors = jax.devices()[:4]
+    rebuild_mesh(wf, survivors)
+    wf.decision.max_epochs = 8
+    wf.decision.complete <<= False
+    wf._finished_.clear()
+    wf.run()
+    assert wf.decision.min_validation_err <= mid_err + 1e-9
+    assert wf.decision.min_validation_err < 0.05
+    some_param = wf.forwards[1].params["wq"]
+    assert len(some_param.devmem.sharding.device_set) == 4

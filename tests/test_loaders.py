@@ -371,3 +371,43 @@ def test_sequence_labels_validated_not_balance_warned(caplog):
     bad = SeqLoader(DummyWorkflow(), minibatch_size=16)
     with pytest.raises(BadFormatError, match="never seen"):
         bad.initialize()
+
+
+def test_object_and_column_labels_analysis():
+    """(N, 1) column labels keep full balance analysis; object-dtype
+    (e.g. string/ragged) labels still fail LOUDLY under
+    validate_labels (the pre-sequence-support behavior)."""
+    import logging
+    from veles_tpu.error import BadFormatError
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+
+    class ColumnLabels(FullBatchLoader):
+        def load_data(self):
+            data = numpy.zeros((64, 4), numpy.float32)
+            labels = numpy.zeros((64, 1), numpy.int32)
+            labels[:2] = 1  # severe imbalance must still warn
+            self.original_data.mem = data
+            self.original_labels.mem = labels
+            self.class_lengths = [0, 0, 64]
+
+    class StringLabels(FullBatchLoader):
+        def load_data(self):
+            self.original_data.mem = numpy.zeros((8, 4),
+                                                 numpy.float32)
+            self.original_labels.mem = numpy.array(
+                ["a", "b"] * 4, dtype=object)
+            self.class_lengths = [0, 0, 8]
+
+    import pytest as _pytest
+    caplog_records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: caplog_records.append(r.getMessage())
+    logging.getLogger().addHandler(handler)
+    try:
+        ColumnLabels(DummyWorkflow(), minibatch_size=16).initialize()
+    finally:
+        logging.getLogger().removeHandler(handler)
+    assert any("imbalanced" in m for m in caplog_records)
+
+    with pytest.raises(BadFormatError, match="not non-negative"):
+        StringLabels(DummyWorkflow(), minibatch_size=8).initialize()

@@ -242,13 +242,14 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             try:
                 a = numpy.asarray(arr)
             except ValueError:
-                self.info("ragged per-sample labels — dataset "
-                          "analysis skipped")
-                return
-            if a.dtype == object:
-                self.info("ragged per-sample labels — dataset "
-                          "analysis skipped")
-                return
+                # Ragged per-sample lists: keep object dtype so the
+                # loop's dtype check below still fails LOUDLY under
+                # validate_labels instead of silently skipping.
+                a = numpy.asarray(arr, dtype=object)
+            if a.ndim > 1:
+                # Trailing singleton axes ((N, 1) column vectors) are
+                # ordinary class labels, not sequences.
+                a = a.squeeze()
             if a.ndim > 1:
                 sequence_labels = True
                 a = a.ravel()
@@ -259,7 +260,6 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         for cls, arr in enumerate(labels):
             if arr is None or not len(arr):
                 continue
-            arr = numpy.asarray(arr)
             if not numpy.issubdtype(arr.dtype, numpy.integer) or \
                     arr.min() < 0:
                 problem = ("%s labels are not non-negative integers "
@@ -312,8 +312,10 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
                 self.info("%s", msg)
         # Distribution drift: a validation/test set whose label mix
         # differs wildly from training skews the reported metrics
-        # (reference _compare_label_distributions).
-        if train_hist:
+        # (reference _compare_label_distributions); token mixes of
+        # sequence targets are expected to drift — skip the whole
+        # computation there.
+        if train_hist and not sequence_labels:
             total_train = sum(train_hist.values())
             for cls in (TEST, VALID):
                 hist = histograms.get(cls)
@@ -324,7 +326,7 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
                     abs(hist.get(lbl, 0) / total -
                         cnt / total_train)
                     for lbl, cnt in train_hist.items())
-                if drift > 0.1 and not sequence_labels:
+                if drift > 0.1:
                     self.warning(
                         "%s label distribution deviates from train "
                         "by up to %.0f%%", CLASS_NAME[cls],
