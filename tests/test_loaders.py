@@ -411,3 +411,25 @@ def test_object_and_column_labels_analysis():
 
     with pytest.raises(BadFormatError, match="not non-negative"):
         StringLabels(DummyWorkflow(), minibatch_size=8).initialize()
+
+
+def test_single_sequence_split_stays_sequence_labels(caplog):
+    """A (1, S) single-sequence split must not be mistaken for S
+    class labels (only trailing singletons squeeze)."""
+    import logging
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+
+    class OneSeq(FullBatchLoader):
+        def load_data(self):
+            self.original_data.mem = numpy.zeros((2, 16),
+                                                 numpy.int32)
+            labels = numpy.zeros((2, 16), numpy.int32)
+            labels[:, 0] = 3  # skewed token mix
+            self.original_labels.mem = labels
+            self.class_lengths = [0, 1, 1]
+
+    ld = OneSeq(DummyWorkflow(), minibatch_size=1)
+    with caplog.at_level(logging.WARNING):
+        ld.initialize()
+    assert not any("imbalanced" in r.message or
+                   "deviates" in r.message for r in caplog.records)

@@ -211,3 +211,31 @@ def test_launcher_payload_carries_graph_and_plots(tmp_path):
     assert "start" in payload["graph"].lower() or \
         "u0" in payload["graph"]
     assert list(payload["plots"]) == ["err"]  # budget enforced
+
+
+def test_oversized_plots_do_not_erase_dashboard(tmp_path):
+    """All-oversized plot sets omit the section (dashboard keeps the
+    previous plots) instead of shipping an erasing empty dict."""
+    from veles_tpu.config import root
+    from veles_tpu.dummy import DummyWorkflow
+    prng.reset()
+    launcher = Launcher()
+    launcher.workflow = DummyWorkflow()
+    plots = tmp_path / "plots"
+    plots.mkdir()
+    (plots / "good.png").write_bytes(TINY_PNG)
+    old = root.common.dirs.get("plots")
+    root.common.dirs.plots = str(plots)
+    try:
+        first = launcher.status_payload("m/1")
+        assert list(first["plots"]) == ["good"]
+        # Replace with an oversized plot only: section must be
+        # OMITTED (None), not an empty dict.
+        (plots / "good.png").unlink()
+        (plots / "huge.png").write_bytes(
+            b"\x89PNG\r\n\x1a\n" + b"0" *
+            (Launcher.PLOT_BYTES_MAX + 1))
+        second = launcher.status_payload("m/2")
+        assert "plots" not in second
+    finally:
+        root.common.dirs.plots = old

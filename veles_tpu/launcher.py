@@ -359,17 +359,23 @@ class Launcher(Logger):
                 st = os.stat(path)
             except OSError:
                 continue  # deleted between glob and stat
+            # Oversized files never ship: exclude them up front so
+            # they neither poison the sent-keys comparison nor shrink
+            # the dashboard's plot set.
+            if st.st_size > self.PLOT_BYTES_MAX:
+                continue
             entries.append((st.st_mtime, path, st.st_size))
         entries.sort(reverse=True)
         keys = tuple((p, m, s) for m, p, s in
                      entries[:self.PLOTS_PER_BEAT])
-        if keys == self._plots_sent_:
+        if keys == self._plots_sent_ or not keys:
+            # Unchanged — or nothing eligible: omit the section so
+            # the dashboard keeps the previously shown plots rather
+            # than receiving an erasing empty dict.
             return None
         out = {}
         cache = self._plots_cache_
         for mtime, path, size in entries[:self.PLOTS_PER_BEAT]:
-            if size > self.PLOT_BYTES_MAX:
-                continue
             name = os.path.splitext(os.path.basename(path))[0]
             cached = cache.get(path)
             if cached is not None and cached[0] == (mtime, size):

@@ -246,10 +246,12 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
                 # loop's dtype check below still fails LOUDLY under
                 # validate_labels instead of silently skipping.
                 a = numpy.asarray(arr, dtype=object)
-            if a.ndim > 1:
-                # Trailing singleton axes ((N, 1) column vectors) are
-                # ordinary class labels, not sequences.
-                a = a.squeeze()
+            # Trailing singleton axes ((N, 1) column vectors) are
+            # ordinary class labels, not sequences; only they are
+            # squeezed — a (1, S) single-sequence split must stay
+            # sequence-shaped.
+            while a.ndim > 1 and a.shape[-1] == 1:
+                a = a[..., 0]
             if a.ndim > 1:
                 sequence_labels = True
                 a = a.ravel()

@@ -255,19 +255,24 @@ class _PoisonedRandom(object):
             "third-party code in prng.unpoisoned()." %
             (item, item, item))
         import os as _os
+        # Installed-library exemption FIRST: a virtualenv living
+        # inside the project directory (cwd/.venv/…/site-packages)
+        # must not turn library-internal draws into crashes.
+        if ("site-packages" in caller or "dist-packages" in caller) \
+                and "veles_tpu" not in caller:
+            return getattr(object.__getattribute__(self, "_real"),
+                           item)
         if "veles_tpu" in caller or \
                 caller.startswith(_os.getcwd()) or any(
                 caller.startswith(p) for p in _guarded_paths):
             raise AttributeError(message)
-        if "site-packages" not in caller and \
-                "dist-packages" not in caller:
-            site = (caller, frame.f_lineno)
-            if site not in _warned_sites:
-                _warned_sites.add(site)
-                logging.getLogger("prng").warning(
-                    "%s (called from %s:%d — warning only: the "
-                    "caller is outside the framework and workflow "
-                    "paths)", message, caller, frame.f_lineno)
+        site = (caller, frame.f_lineno)
+        if site not in _warned_sites:
+            _warned_sites.add(site)
+            logging.getLogger("prng").warning(
+                "%s (called from %s:%d — warning only: the "
+                "caller is outside the framework and workflow "
+                "paths)", message, caller, frame.f_lineno)
         return getattr(object.__getattribute__(self, "_real"), item)
 
 

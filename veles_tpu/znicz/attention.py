@@ -66,6 +66,22 @@ def transformer_block_apply(params, x, n_heads, causal, cdt,
     return x.astype(jnp.float32)
 
 
+def _block_param_shapes(embed, hidden):
+    """Parameter geometry of one dense pre-LN block — single source
+    of truth for TransformerBlock and the pipelined stack (which
+    prepends a stage dim)."""
+    return {
+        "ln1_g": (embed,), "ln1_b": (embed,),
+        "wq": (embed, embed), "wk": (embed, embed),
+        "wv": (embed, embed), "wo": (embed, embed),
+        "bq": (embed,), "bk": (embed,), "bv": (embed,),
+        "bo": (embed,),
+        "ln2_g": (embed,), "ln2_b": (embed,),
+        "w1": (embed, hidden), "b1": (hidden,),
+        "w2": (hidden, embed), "b2": (embed,),
+    }
+
+
 class Embedding(ForwardBase):
     """Token + learned positional embedding: int32 tokens (B, S) →
     activations (B, S, E)."""
@@ -161,16 +177,7 @@ class TransformerBlock(ForwardBase):
                              % (embed, self.n_heads))
         hidden = embed * self.mlp_ratio
         stddev = self.weights_stddev or (1.0 / numpy.sqrt(embed))
-        shapes = {
-            "ln1_g": (embed,), "ln1_b": (embed,),
-            "wq": (embed, embed), "wk": (embed, embed),
-            "wv": (embed, embed), "wo": (embed, embed),
-            "bq": (embed,), "bk": (embed,), "bv": (embed,),
-            "bo": (embed,),
-            "ln2_g": (embed,), "ln2_b": (embed,),
-            "w1": (embed, hidden), "b1": (hidden,),
-            "w2": (hidden, embed), "b2": (embed,),
-        }
+        shapes = _block_param_shapes(embed, hidden)
         for name, shape in shapes.items():
             vec = self.params[name]
             if vec:
@@ -321,16 +328,7 @@ class PipelinedTransformerStack(ForwardBase):
                              % (embed, self.n_heads))
         hidden = embed * self.mlp_ratio
         stddev = self.weights_stddev or (1.0 / numpy.sqrt(embed))
-        shapes = {
-            "ln1_g": (embed,), "ln1_b": (embed,),
-            "wq": (embed, embed), "wk": (embed, embed),
-            "wv": (embed, embed), "wo": (embed, embed),
-            "bq": (embed,), "bk": (embed,), "bv": (embed,),
-            "bo": (embed,),
-            "ln2_g": (embed,), "ln2_b": (embed,),
-            "w1": (embed, hidden), "b1": (hidden,),
-            "w2": (hidden, embed), "b2": (embed,),
-        }
+        shapes = _block_param_shapes(embed, hidden)
         for name, shape in shapes.items():
             vec = self.params[name]
             if vec:
