@@ -483,3 +483,64 @@ def test_lm_elastic_rebuild_on_chip_loss():
     assert wf.decision.min_validation_err < 0.05
     some_param = wf.forwards[1].params["wq"]
     assert len(some_param.devmem.sharding.device_set) == 4
+
+
+def test_moe_capacity_one_drops_overflow_to_residual():
+    """capacity=1 with every token preferring one expert: exactly one
+    token computes, the rest emit zeros (the residual path carries
+    them) — the documented top-1 overflow behavior."""
+    import jax.numpy as jnp
+    from veles_tpu.ops.moe import moe_ffn
+    rng = numpy.random.RandomState(0)
+    T, D, H = 8, 4, 8
+    x = rng.normal(0, 1, (T, D)).astype(numpy.float32)
+    router = numpy.zeros((D, 2), numpy.float32)
+    router[0, 0] = 100.0  # everyone routes to expert 0
+    x[:, 0] = 1.0
+    w1 = rng.normal(0, 0.3, (2, D, H)).astype(numpy.float32)
+    b1 = numpy.zeros((2, H), numpy.float32)
+    w2 = rng.normal(0, 0.3, (2, H, D)).astype(numpy.float32)
+    b2 = numpy.zeros((2, D), numpy.float32)
+    y, aux, load = moe_ffn(jnp.asarray(x), router, w1, b1, w2, b2,
+                           capacity_factor=0.25)  # cap = 0.25*8/2 = 1
+    y = numpy.asarray(y)
+    nonzero_rows = (numpy.abs(y).sum(axis=1) > 1e-6).sum()
+    assert nonzero_rows == 1  # exactly capacity tokens computed
+    assert float(load[0]) == T  # pre-capacity demand recorded
+
+
+def test_gpipe_single_stage_degenerates_to_plain_apply():
+    """A 1-stage 'pipeline' must equal direct application (the ramp
+    logic has no off-by-one at the degenerate boundary)."""
+    import jax.numpy as jnp
+    from veles_tpu.ops.pipeline import gpipe, sequential_stack
+    from veles_tpu.znicz.attention import transformer_block_apply
+    params = _stack_params(1, seed=9)
+    x = numpy.random.RandomState(9).normal(
+        0, 1, (4, 8, 16)).astype(numpy.float32)
+
+    def fn(p, h):
+        return transformer_block_apply(p, h, n_heads=2, causal=True,
+                                       cdt=jnp.float32)
+
+    mesh = make_mesh(axes={"stage": 1})
+    pipe = gpipe(fn, params, jnp.asarray(x), mesh, "stage",
+                 n_microbatches=4)
+    seq = sequential_stack(fn, params, jnp.asarray(x))
+    numpy.testing.assert_allclose(numpy.asarray(pipe),
+                                  numpy.asarray(seq),
+                                  rtol=2e-5, atol=2e-5)
+
+
+def test_gpipe_rejects_bad_geometry():
+    import jax.numpy as jnp
+    from veles_tpu.ops.pipeline import gpipe
+    params = _stack_params(3)
+    x = jnp.zeros((4, 8, 16), jnp.float32)
+    mesh = make_mesh(axes={"stage": 4})
+    with pytest.raises(ValueError, match="stages"):
+        gpipe(lambda p, h: h, params, x, mesh, "stage", 2)
+    params4 = _stack_params(4)
+    with pytest.raises(ValueError, match="microbatches"):
+        gpipe(lambda p, h: h, params4, jnp.zeros((5, 8, 16)),
+              mesh, "stage", 2)
