@@ -67,13 +67,29 @@ def test_ring_gradients_match_full():
 
 
 def test_fully_masked_rows_are_finite():
-    """A row whose every key is masked (first ring step of a strictly
-    later shard) must produce zeros, not NaN."""
-    from veles_tpu.ops.attention import attention
+    """A row whose every key is masked (ring step where the query
+    block is strictly BEFORE the key block) must produce exact zeros,
+    not NaN.  Driven through _block_update with an explicit key
+    offset — attention() itself always builds its mask with both
+    offsets 0, so slicing k can never fully mask a row."""
+    import jax.numpy as jnp
+    from veles_tpu.ops.attention import (NEG_INF, _block_update,
+                                         _causal_mask, _finish)
     q, k, v = _qkv(S=8)
-    # causal with the query block BEFORE the key block: mask all.
-    out = attention(q[:, :4], k[:, 4:], v[:, 4:], causal=True)
-    assert numpy.isfinite(numpy.asarray(out)).all()
+    S = 8
+    # Query positions 0..7, key positions S..2S-1: every (q, k) pair
+    # violates causality, so the mask is all-False.
+    mask = _causal_mask(S, S, 0, S)
+    assert not bool(numpy.asarray(mask).any())
+    acc = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    l = jnp.zeros(q.shape[:3], jnp.float32)
+    acc, m, l = _block_update(acc, m, l, q, k, v,
+                              scale=1.0 / q.shape[-1] ** 0.5,
+                              mask=mask)
+    out = numpy.asarray(_finish(acc, l, q.dtype))
+    assert numpy.isfinite(out).all()
+    numpy.testing.assert_array_equal(out, numpy.zeros_like(out))
 
 
 def _train_tinylm(**kwargs):

@@ -263,7 +263,8 @@ class _PoisonedRandom(object):
             return getattr(object.__getattribute__(self, "_real"),
                            item)
         if "veles_tpu" in caller or \
-                caller.startswith(_os.getcwd()) or any(
+                (_launch_cwd[0] is not None and
+                 caller.startswith(_launch_cwd[0])) or any(
                 caller.startswith(p) for p in _guarded_paths):
             raise AttributeError(message)
         site = (caller, frame.f_lineno)
@@ -276,6 +277,24 @@ class _PoisonedRandom(object):
         return getattr(object.__getattribute__(self, "_real"), item)
 
 
+#: The "user code" cwd prefix, captured ONCE at poison time: a per-call
+#: os.getcwd() would silently change guard semantics on chdir, and a
+#: root-ish cwd ('/', common in containers) would classify the entire
+#: filesystem — stdlib included — as user code.
+_launch_cwd = [None]
+
+
+def _capture_launch_cwd():
+    import os as _os
+    cwd = _os.getcwd().rstrip(_os.sep)
+    # A filesystem root or other very short prefix matches everything;
+    # disable the cwd rule rather than make it a global tripwire.
+    # The stored prefix ends with a separator so a sibling directory
+    # sharing the cwd as a string prefix (/root/repo-libs vs
+    # /root/repo) never matches.
+    _launch_cwd[0] = cwd + _os.sep if len(cwd) > 3 else None
+
+
 def poison_numpy_random():
     """Installs the guard (idempotent).  Covers both access routes:
     ``numpy.random.rand(...)`` (package attribute) and
@@ -283,6 +302,7 @@ def poison_numpy_random():
     imported *before* poisoning can't be revoked — same limitation as
     the reference guard."""
     import sys as _sys
+    _capture_launch_cwd()
     if not isinstance(numpy.random, _PoisonedRandom):
         poisoned = _PoisonedRandom(_np_random)
         numpy.random = poisoned

@@ -163,12 +163,25 @@ class BBoxerServer(JsonHttpServer):
                     return
                 try:
                     payload = self.read_json()
-                    outer.save_selections(payload["file"],
-                                          payload["selections"])
+                    name = payload["file"]
+                    boxes = payload["selections"]
                 except (ValueError, KeyError, TypeError):
                     self.reply(400, {"error": "bad selection payload"})
                     return
-                self.reply(200, {"status": "saved"})
+                try:
+                    outer.save_selections(name, boxes)
+                except (ValueError, TypeError):
+                    self.reply(400, {"error": "bad selection payload"})
+                except KeyError:
+                    # Same status/shape as the GET handlers for an
+                    # unknown or non-image name.
+                    self.reply(404, {"error": "unknown image"})
+                except OSError:
+                    # Server-side disk failure (ENOSPC, EACCES) is not
+                    # the client's fault.
+                    self.reply(500, {"error": "cannot write sidecar"})
+                else:
+                    self.reply(200, {"status": "saved"})
 
         super(BBoxerServer, self).__init__(
             Handler, host=host, port=port, thread_name="veles-bboxer")
@@ -213,6 +226,12 @@ class BBoxerServer(JsonHttpServer):
 
     def save_selections(self, name, selections):
         path = self._resolve(name)
+        # Sidecars only for actual images in the tree — a request for
+        # a nonexistent or non-image name must not create stray .json
+        # files (and would 500 on a missing subdirectory otherwise).
+        if not path.lower().endswith(IMAGE_EXTENSIONS) or \
+                not os.path.isfile(path):
+            raise KeyError(name)
         clean = []
         for b in selections:
             clean.append({

@@ -183,20 +183,35 @@ class WebStatusServer(JsonHttpServer):
     _SVG_CACHE = {}
     _SVG_CACHE_MAX = 32
 
-    @classmethod
-    def _render_graph(cls, dot):
+    def _render_graph(self, dot):
         """Workflow graph section (reference: web_status.py:113-243
         shows the Graphviz graph).  When the graphviz binary exists
         the DOT is rendered server-side to SVG and embedded as a
         data-URI <img> (img context: embedded scripts in a hostile
         SVG never execute); the DOT source is always available in a
-        collapsible block."""
+        collapsible block.
+
+        Server-side rendering runs ONLY when heartbeat POSTs require
+        the status token: without auth, any client could POST
+        arbitrary DOT to be parsed by the graphviz C library (a
+        memory-unsafety attack surface) and each hash-distinct DOT
+        costs a subprocess with a 10 s timeout (cheap DoS).  Unauth'd
+        deployments still get the escaped DOT source block."""
         if not dot or not isinstance(dot, str) or len(dot) > 65536:
             return ""
         import base64
         import hashlib
         import shutil
         import subprocess
+        dot_src = ("<details><summary>workflow graph (DOT)</summary>"
+                   "<pre>%s</pre></details>" %
+                   html.escape(dot, quote=True))
+        if self.token is None:
+            # No cache interaction either: a token-less instance must
+            # not poison the class-level cache with empty renders for
+            # an authed instance in the same process.
+            return "<h3>graph</h3>" + dot_src
+        cls = type(self)
         key = hashlib.sha256(dot.encode()).hexdigest()
         svg_img = cls._SVG_CACHE.get(key)
         if svg_img is None:
@@ -218,10 +233,7 @@ class WebStatusServer(JsonHttpServer):
             if len(cls._SVG_CACHE) >= cls._SVG_CACHE_MAX:
                 cls._SVG_CACHE.clear()
             cls._SVG_CACHE[key] = svg_img
-        return ("<h3>graph</h3>" + svg_img +
-                "<details><summary>workflow graph (DOT)</summary>"
-                "<pre>%s</pre></details>" %
-                html.escape(dot, quote=True))
+        return "<h3>graph</h3>" + svg_img + dot_src
 
     @staticmethod
     def _render_plots(plots):

@@ -203,7 +203,32 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
         self.gradient_moment = kwargs.get("gradient_moment", 0.0)
         self.gradient_moment_bias = kwargs.get(
             "gradient_moment_bias", self.gradient_moment)
+        # Bias-hyper tying is STRUCTURAL (was *_bias omitted at
+        # construction?), not value equality: a user who explicitly
+        # sets learning_rate_bias to the same number as learning_rate
+        # has decoupled it, and a traced population gene must then
+        # not leak onto the bias slot.
+        self._bias_tied = {
+            "learning_rate": "learning_rate_bias" not in kwargs,
+            "gradient_moment": "gradient_moment_bias" not in kwargs,
+        }
         self._velocities = {}
+
+    def init_unpickled(self):
+        super(GradientDescentBase, self).init_unpickled()
+        # A snapshot from before the structural flag existed carries
+        # no _bias_tied: reconstruct it from value equality (the old
+        # semantics) so a restored population keeps tying the way it
+        # trained.  During construction the hyper attrs don't exist
+        # yet and __init__ sets the flags right after.
+        if not hasattr(self, "_bias_tied") and \
+                hasattr(self, "learning_rate"):
+            self._bias_tied = {
+                "learning_rate":
+                    self.learning_rate_bias == self.learning_rate,
+                "gradient_moment":
+                    self.gradient_moment_bias == self.gradient_moment,
+            }
 
     def link_target(self, target):
         self.target = target
@@ -252,16 +277,14 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
         # vmapped path trains the same model the per-chromosome path
         # does.
         names = ("learning_rate", "weights_decay", "gradient_moment")
-        plain = (self.learning_rate, self.weights_decay,
-                 self.gradient_moment)
         out = []
-        for name, own_v, plain_v in zip(names, own, plain):
+        for name, own_v in zip(names, own):
             if suffix:
                 # weights_decay_bias constructor-defaults to 0.0, NOT
                 # to weights_decay — so a traced plain decay must
                 # never leak onto biases (the per-chromosome path
                 # keeps bias decay at its own value).
-                ties = name != "weights_decay" and own_v == plain_v
+                ties = getattr(self, "_bias_tied", {}).get(name, False)
                 tied_default = hypers.get(name, own_v) if ties \
                     else own_v
                 out.append(hypers.get(name + suffix, tied_default))
