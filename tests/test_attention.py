@@ -350,6 +350,62 @@ def test_ulysses_matches_full(causal):
                                   rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("S", [1536, 1200])
+def test_ulysses_long_gathered_sequences(causal, S):
+    """The gathered local attention must handle ANY long S — 1536
+    (streams at a dividing block size) and 1200 (divides by nothing
+    in the block ladder: pads to a block multiple with masked keys).
+    Pre-round-5 both fell back to dense O(S²) scores (the shape
+    cliff: `S > 1024 and S % 512 == 0` was the only streamed case)."""
+    from veles_tpu.ops.attention import attention, \
+        sequence_parallel_attention
+    q, k, v = _qkv(B=1, S=S, H=8, D=8)
+    mesh = make_mesh(axes={"seq": 8})
+    full = attention(q, k, v, causal=causal)
+    uly = sequence_parallel_attention(q, k, v, mesh, "seq",
+                                      causal=causal, mode="ulysses")
+    numpy.testing.assert_allclose(full, numpy.asarray(uly),
+                                  rtol=2e-5, atol=3e-5)
+
+
+def test_gathered_attention_never_dense_past_threshold(monkeypatch):
+    """Above ULYSSES_DENSE_MAX the dense path must not run at all —
+    guard the streaming guarantee itself, not just numerics."""
+    import jax.numpy as jnp
+    from veles_tpu.ops import attention as A
+
+    def boom(*a, **kw):
+        raise AssertionError("dense attention called for long S")
+
+    monkeypatch.setattr(A, "attention", boom)
+    for S in (1088, 1200, 1536):
+        q = jnp.zeros((1, S, 2, 4))
+        out = A._gathered_attention(q, q, q, causal=True)
+        assert out.shape == q.shape
+    # ...and at/below the threshold dense is still the choice.
+    q = jnp.zeros((1, A.ULYSSES_DENSE_MAX, 2, 4))
+    with pytest.raises(AssertionError, match="dense"):
+        A._gathered_attention(q, q, q, causal=True)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_blockwise_kv_len_masks_padding(causal):
+    """kv_len must make padded keys invisible: padded blockwise ==
+    dense over the unpadded operands (the non-causal case is the
+    dangerous one — zero-padding is attendable without the mask)."""
+    from veles_tpu.ops.attention import attention, blockwise_attention
+    q, k, v = _qkv(B=1, S=48, H=2, D=8)
+    pad = 16
+    qp, kp, vp = [numpy.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                  for x in (q, k, v)]
+    ref = attention(q, k, v, causal=causal)
+    got = blockwise_attention(qp, kp, vp, block_size=16,
+                              causal=causal, kv_len=48)
+    numpy.testing.assert_allclose(ref, numpy.asarray(got)[:, :48],
+                                  rtol=2e-5, atol=2e-5)
+
+
 def test_ulysses_rejects_indivisible_heads():
     import jax.numpy as jnp
     from veles_tpu.ops.attention import sequence_parallel_attention

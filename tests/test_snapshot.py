@@ -69,6 +69,83 @@ def test_snapshot_preserves_weights(tmp_path):
     numpy.testing.assert_array_equal(wf2.forwards[0].weights.mem, w)
 
 
+def _build_sharded_lm(tmp_path, max_epochs=2):
+    """TinyLM under dp×tp(2×4) with an improved-epoch snapshotter."""
+    import jax
+    from veles_tpu.parallel import make_mesh, apply_dp_tp_sharding
+    from veles_tpu.znicz.samples.tinylm import TinyLMWorkflow
+    prng.reset()
+    prng.get(0).seed(21)
+    launcher = Launcher()
+    wf = TinyLMWorkflow(launcher, max_epochs=max_epochs)
+    snap = SnapshotterToFile(wf, directory=str(tmp_path),
+                             prefix="lm", time_interval=0.0)
+    snap.link_from(wf.decision)
+    snap.gate_skip = ~wf.decision.improved
+    wf.gds[0].unlink_from(wf.decision)
+    wf.gds[0].link_from(snap)
+    snap.link_attrs(wf.decision, ("suffix", "snapshot_suffix"))
+    launcher.initialize()
+    apply_dp_tp_sharding(
+        wf, make_mesh(jax.devices(), {"data": 2, "model": 4}))
+    launcher._finished.clear()
+    wf.run()
+    return wf, snap
+
+
+def test_cross_topology_snapshot_restore(tmp_path):
+    """SURVEY §7 hard part: a snapshot taken under dp×tp on EIGHT
+    devices must resume on FOUR (re-sharded 2×2) and on ONE (no
+    mesh) — shardings are transient, re-applied at restore onto
+    whatever topology exists then — and training must continue from
+    the checkpointed state on both."""
+    import jax
+    from veles_tpu.parallel import make_mesh, apply_dp_tp_sharding
+    wf, snap = _build_sharded_lm(tmp_path)
+    first_err = wf.decision.min_validation_err
+    first_epochs = wf.decision.epoch_number
+
+    # --- resume on 4 devices, re-sharded dp×tp 2×2 --------------------
+    wf4 = SnapshotterToFile.import_(snap.destination)
+    blk4 = wf4.forwards[1]
+    # The pickled Vectors carry data but NO topology-bound sharding.
+    assert blk4.params["wq"].sharding is None
+    assert wf4.mesh is None
+    assert wf4.decision.epoch_number == first_epochs
+    launcher4 = Launcher()
+    launcher4.add_ref(wf4)
+    wf4.decision.max_epochs = 8
+    launcher4.initialize(snapshot=True)
+    apply_dp_tp_sharding(
+        wf4, make_mesh(jax.devices()[:4], {"data": 2, "model": 2}))
+    launcher4._finished.clear()
+    wf4.run()
+    assert wf4.decision.epoch_number == 8
+    assert wf4.decision.min_validation_err <= first_err + 1e-9
+    # Resumed training on the new topology converges to the gate.
+    assert wf4.decision.min_validation_err < 0.05
+    p4 = blk4.params["wq"].devmem
+    assert len(p4.sharding.device_set) == 4
+
+    # --- resume on ONE device (plain single-chip training) ------------
+    wf1 = SnapshotterToFile.import_(snap.destination)
+    # Both restores start from the identical checkpointed weights.
+    numpy.testing.assert_array_equal(
+        wf1.embedding.weights.mem,
+        SnapshotterToFile.import_(
+            snap.destination).embedding.weights.mem)
+    launcher1 = Launcher()
+    launcher1.add_ref(wf1)
+    wf1.decision.max_epochs = 4
+    launcher1.initialize(snapshot=True)
+    launcher1.run()
+    assert wf1.decision.epoch_number == 4
+    # Training continued from the checkpointed state (no reset).
+    assert wf1.decision.min_validation_err <= first_err + 1e-9
+    some = wf1.forwards[1].params["wq"].devmem
+    assert len(some.sharding.device_set) == 1
+
+
 def test_snapshot_excludes_launcher(tmp_path):
     prng.reset()
     prng.get(0).seed(13)

@@ -308,9 +308,17 @@ class Vector(Pickleable):
             state["_shallow_shape"] = self.shape
             state["_shallow_dtype"] = str(self.dtype) \
                 if self.dtype is not None else None
+            state["_sharding"] = None
             return state
         self.map_read()
-        return super(Vector, self).__getstate__()
+        state = super(Vector, self).__getstate__()
+        # A NamedSharding holds the live Mesh/Device objects — never
+        # picklable, and topology-bound anyway: a snapshot restores
+        # onto WHATEVER devices exist then (possibly fewer/more), and
+        # the parallel appliers re-annotate at that point (SURVEY §7
+        # cross-topology resume).
+        state["_sharding"] = None
+        return state
 
 
 #: Reference-compatible alias (veles.memory.Array).

@@ -84,9 +84,15 @@ def attention(q, k, v, causal=False):
     return _finish(acc, l, q.dtype)
 
 
-def blockwise_attention(q, k, v, block_size=128, causal=False):
+def blockwise_attention(q, k, v, block_size=128, causal=False,
+                        kv_len=None):
     """Flash-style attention: scan over key/value blocks with the
-    streaming accumulator — O(S·block) memory on one device."""
+    streaming accumulator — O(S·block) memory on one device.
+
+    ``kv_len``: when set, keys at global positions >= kv_len are
+    masked out — the padding contract for callers that padded k/v up
+    to a block multiple (non-causal attention would otherwise attend
+    the zero padding)."""
     B, S, H, D = q.shape
     if S % block_size:
         raise ValueError("sequence %d not divisible by block %d" %
@@ -99,8 +105,15 @@ def blockwise_attention(q, k, v, block_size=128, causal=False):
     def body(carry, xs):
         acc, m, l = carry
         kblk, vblk, idx = xs
-        mask = _causal_mask(S, block_size, 0, idx * block_size) \
+        k_off = idx * block_size
+        mask = _causal_mask(S, block_size, 0, k_off) \
             if causal else None
+        if kv_len is not None:
+            kvalid = jnp.broadcast_to(
+                (k_off + jnp.arange(block_size))[None, :] < kv_len,
+                (S, block_size))
+            mask = kvalid if mask is None else \
+                jnp.logical_and(mask, kvalid)
         acc, m, l = _block_update(acc, m, l, q, kblk, vblk,
                                   scale=scale, mask=mask)
         return (acc, m, l), None
@@ -180,20 +193,45 @@ def ulysses_attention(q, k, v, axis_name, causal=False):
                               concat_axis=2, tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    S = qh.shape[1]
-    # The gathered sequence is full-length: O(S²) scores would defeat
-    # the long-context purpose, so stream blockwise once S is big.
-    if S > 1024 and S % 512 == 0:
-        out = blockwise_attention(qh, kh, vh, block_size=512,
-                                  causal=causal)
-    else:
-        out = attention(qh, kh, vh, causal=causal)
+    out = _gathered_attention(qh, kh, vh, causal)
     return to_seq(out)
+
+
+#: Above this gathered length the local attention MUST stream
+#: blockwise — a dense S×S score tensor is exactly the blow-up
+#: sequence parallelism exists to avoid.
+ULYSSES_DENSE_MAX = 1024
+
+
+def _gathered_attention(q, k, v, causal):
+    """Local attention over the Ulysses-gathered (full-S, head-shard)
+    activations.  S <= ULYSSES_DENSE_MAX runs dense; anything longer
+    streams blockwise at the largest dividing block size, PADDING up
+    to a block multiple when nothing divides — never silently dense
+    (the pre-round-5 behavior fell back to O(S²) scores for
+    S = 1025..1535 and any non-multiple of 512)."""
+    S = q.shape[1]
+    if S <= ULYSSES_DENSE_MAX:
+        return attention(q, k, v, causal=causal)
+    for bs in (512, 384, 256, 128, 64):
+        if S % bs == 0:
+            return blockwise_attention(q, k, v, block_size=bs,
+                                       causal=causal)
+    bs = 512
+    pad = (-S) % bs
+    padded = [jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+              for x in (q, k, v)]
+    # kv_len masks the padded keys (a causal mask alone would let
+    # NON-causal attention read the zero padding); padded query rows
+    # are garbage and sliced away.
+    out = blockwise_attention(*padded, block_size=bs, causal=causal,
+                              kv_len=S)
+    return out[:, :S]
 
 
 def sequence_parallel_attention(q, k, v, mesh, seq_axis,
                                 causal=False, batch_axis=None,
-                                mode="ring"):
+                                mode="ring", head_axis=None):
     """Wraps a sequence-parallel attention (``mode``: "ring" →
     :func:`ring_attention`, "ulysses" → :func:`ulysses_attention`) in
     ``shard_map`` over the mesh's sequence axis (activations
@@ -202,7 +240,10 @@ def sequence_parallel_attention(q, k, v, mesh, seq_axis,
     over ICI, and the result comes back sequence-sharded.
     ``batch_axis`` keeps the batch dim data-parallel inside the
     shard_map (dp × sp composes: the collectives involve only
-    ``seq_axis``)."""
+    ``seq_axis``); ``head_axis`` keeps the head dim TENSOR-parallel
+    (dp × tp × sp composes: attention is per-head, so a Megatron
+    head shard rotates only its own heads' k/v around the ring —
+    no model-axis collective is ever needed inside)."""
     import inspect
     try:
         from jax import shard_map
@@ -216,7 +257,9 @@ def sequence_parallel_attention(q, k, v, mesh, seq_axis,
     from jax.sharding import PartitionSpec as P
     if batch_axis is not None and batch_axis not in mesh.axis_names:
         batch_axis = None
-    spec = P(batch_axis, seq_axis, None, None)
+    if head_axis is not None and head_axis not in mesh.axis_names:
+        head_axis = None
+    spec = P(batch_axis, seq_axis, head_axis, None)
     modes = {"ring": ring_attention, "ulysses": ulysses_attention}
     assert set(modes) == set(SP_MODES)
     if mode not in modes:

@@ -91,21 +91,120 @@ def apply_dp_sharding(workflow, mesh, axis="data"):
     return workflow
 
 
+def _transformer_tp_plan(unit, n_model, model_axis):
+    """Megatron-style PartitionSpecs for one transformer-family unit,
+    or None when its geometry does not divide the model axis.
+
+    The layout is the standard column→row pairing, expressed as
+    GSPMD annotations instead of manual collectives (XLA inserts the
+    all-reduce after each row-parallel matmul):
+
+      * attention: wq/wk/wv COLUMN-sharded (each model shard computes
+        E/n output features = H/n whole heads; the (B,S,H,D) reshape
+        keeps the head dim sharded because n | H), wo ROW-sharded
+        (partial sums psum to a replicated residual);
+      * MLP: w1 column, w2 row — the hidden dim lives sharded, the
+        residual stream stays replicated;
+      * MoE experts: same column/row pairing on the per-expert
+        matrices (trailing dims; the leading expert dim is the
+        EXPERT axis's business, composable);
+      * pipelined stacks: same specs with the leading stage dim left
+        to the STAGE axis;
+      * LMHead: vocab (output) column-sharded — the loss's
+        log-softmax reduction over the sharded vocab becomes an XLA
+        collective;
+      * Embedding: embed dim sharded (the vocab-dim gather stays
+        local per shard); a TIED head then contracts over the sharded
+        embed dim — a row-parallel linear ending in a psum.
+    """
+    from ..znicz.attention import (Embedding, LMHead,
+                                   MoETransformerBlock,
+                                   PipelinedTransformerStack,
+                                   TransformerBlock)
+
+    def spec(*axes):
+        return PartitionSpec(*axes)
+
+    if isinstance(unit, (TransformerBlock, PipelinedTransformerStack)):
+        embed = unit.input.shape[-1]
+        hidden = embed * unit.mlp_ratio
+        if embed % n_model or hidden % n_model or \
+                unit.n_heads % n_model:
+            return None
+        col, row, vec, rep = ((None, model_axis),
+                              (model_axis, None),
+                              (model_axis,), ())
+        if isinstance(unit, MoETransformerBlock):
+            plan = {
+                "wq": col, "wk": col, "wv": col, "wo": row,
+                "bq": vec, "bk": vec, "bv": vec, "bo": rep,
+                "ln1_g": rep, "ln1_b": rep,
+                "ln2_g": rep, "ln2_b": rep,
+                "router": rep,
+                # Per-expert column/row pairing on the TRAILING dims;
+                # the leading expert dim stays None here (the expert
+                # axis shards it, composably).
+                "w1": (None,) + col, "b1": (None,) + vec,
+                "w2": (None,) + row, "b2": (None,) + rep,
+            }
+        elif isinstance(unit, PipelinedTransformerStack):
+            plan = {
+                "wq": (None,) + col, "wk": (None,) + col,
+                "wv": (None,) + col, "wo": (None,) + row,
+                "bq": (None,) + vec, "bk": (None,) + vec,
+                "bv": (None,) + vec, "bo": (None,) + rep,
+                "ln1_g": (None,) + rep, "ln1_b": (None,) + rep,
+                "ln2_g": (None,) + rep, "ln2_b": (None,) + rep,
+                "w1": (None,) + col, "b1": (None,) + vec,
+                "w2": (None,) + row, "b2": (None,) + rep,
+            }
+        else:
+            plan = {
+                "wq": col, "wk": col, "wv": col, "wo": row,
+                "bq": vec, "bk": vec, "bv": vec, "bo": rep,
+                "ln1_g": rep, "ln1_b": rep,
+                "ln2_g": rep, "ln2_b": rep,
+                "w1": col, "b1": vec, "w2": row, "b2": rep,
+            }
+        return {name: spec(*axes) for name, axes in plan.items()
+                if name in unit.trainables}
+    if isinstance(unit, LMHead):
+        plan = {}
+        w = unit.trainables.get("weights")
+        if w and w.shape[-1] % n_model == 0:
+            plan["weights"] = spec(None, model_axis)
+            b = unit.trainables.get("bias")
+            if b:
+                plan["bias"] = spec(model_axis)
+        return plan or None
+    if isinstance(unit, Embedding):
+        w = unit.trainables.get("weights")
+        if w is None or not w or w.shape[-1] % n_model:
+            return None
+        plan = {"weights": spec(None, model_axis)}
+        if unit.pos:
+            plan["pos"] = spec(None, model_axis)
+        return plan
+    return None
+
+
 def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
                          model_axis="model"):
     """Data × tensor parallelism over a 2-axis mesh — the "natural
     XLA extension" beyond the reference's DP-only engine (SURVEY
     §2.3): dense layers' weight matrices shard along their OUTPUT
     dimension on ``model_axis`` (so each model-shard computes a slice
-    of the layer's neurons from the full input), optimizer momentum
-    shards identically, batches shard on ``data_axis``.  No manual
+    of the layer's neurons from the full input), the transformer
+    family gets the full Megatron-style column/row pairing
+    (:func:`_transformer_tp_plan`), optimizer momentum shards
+    identically, batches shard on ``data_axis``.  No manual
     collectives: XLA's sharding propagation inserts the
     all-gather/reduce-scatter pattern between layers and the gradient
     psum over the data axis — the same compiled step, just annotated
     differently.
 
-    Layers whose output width does not divide the model-axis size
-    stay replicated (correct, merely less parallel).
+    Layers whose geometry does not divide the model-axis size stay
+    replicated (correct, merely less parallel).
     """
     from ..znicz.all2all import All2All
 
@@ -117,8 +216,31 @@ def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
     gd_of = {gd.target: gd
              for gd in getattr(workflow, "gds", [])
              if getattr(gd, "target", None) is not None}
+
+    def shard_slots_by_name(unit, gd):
+        """Optimizer slots mirror their parameter BY NAME
+        (velocity_<param>) — shape matching alone could collide
+        (e.g. wq/wk/wv are all (E, E))."""
+        if gd is None:
+            return
+        for name, vec in gd.tstate.items():
+            pname = name[len("velocity_"):] \
+                if name.startswith("velocity_") else name
+            target = unit.trainables.get(pname)
+            if vec and target is not None and \
+                    tuple(vec.shape) == tuple(target.shape):
+                vec.sharding = target.sharding
+
     sharded_layers = 0
     for unit in getattr(workflow, "forwards", []):
+        plan = _transformer_tp_plan(unit, n_model, model_axis)
+        if plan:
+            for pname, pspec in plan.items():
+                unit.trainables[pname].sharding = \
+                    NamedSharding(mesh, pspec)
+            shard_slots_by_name(unit, gd_of.get(unit))
+            sharded_layers += 1
+            continue
         if not isinstance(unit, All2All):
             continue
         weights = unit.trainables.get("weights")
@@ -146,10 +268,47 @@ def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
                     vec.sharding = vec_sharded
     if sharded_layers == 0:
         workflow.warning(
-            "apply_dp_tp_sharding: no dense layer width divides the "
+            "apply_dp_tp_sharding: no layer geometry divides the "
             "model axis (%d) — the workflow runs data-parallel only"
             % n_model)
     workflow._parallel_style_ = ("dp_tp", data_axis, model_axis)
+    return workflow
+
+
+def apply_dp_tp_sp_sharding(workflow, mesh, data_axis="data",
+                            model_axis="model", seq_axis="seq"):
+    """COMPOSED 3-axis layout: data × tensor × sequence parallelism.
+
+    The Megatron column/row weight sharding comes from
+    :func:`apply_dp_tp_sharding`; every transformer unit that
+    declares this ``seq_axis`` additionally runs its attention
+    sequence-parallel (ring or Ulysses) INSIDE a shard_map whose
+    specs now carry the model axis on the HEAD dim — attention is
+    per-head, so head-sharding composes with the sequence collectives
+    for free: the ring's ppermutes involve only ``seq_axis``, each
+    model shard rotates only its own heads' k/v.
+
+    Mesh shape: (data, model, seq).  Activations (B, S, H, D) inside
+    attention are sharded (data, seq, model, None).
+    """
+    apply_dp_tp_sharding(workflow, mesh, data_axis=data_axis,
+                         model_axis=model_axis)
+    n_model = mesh.shape[model_axis]
+    sp_blocks = 0
+    for unit in getattr(workflow, "forwards", []):
+        if getattr(unit, "seq_axis", None) != seq_axis:
+            continue
+        unit.batch_axis = data_axis
+        if getattr(unit, "n_heads", 0) % n_model == 0:
+            unit.head_axis = model_axis
+        sp_blocks += 1
+    if sp_blocks == 0:
+        workflow.warning(
+            "apply_dp_tp_sp_sharding: no forward unit declares "
+            "seq_axis=%r — attention runs without sequence "
+            "parallelism" % seq_axis)
+    workflow._parallel_style_ = ("dp_tp_sp", data_axis, model_axis,
+                                 seq_axis)
     return workflow
 
 
@@ -288,6 +447,93 @@ def apply_dp_pp_sharding(workflow, mesh, data_axis="data",
     return workflow
 
 
+#: Style name → the sharding applier re-run over the shrunk mesh.
+#: (2-axis styles all carry (name, data_axis, other_axis); the 3-axis
+#: dp_tp_sp carries (name, data, model, seq).)
+def _style_appliers():
+    return {
+        "dp_tp": apply_dp_tp_sharding,
+        "dp_sp": apply_dp_sp_sharding,
+        "dp_ep": apply_dp_ep_sharding,
+        "dp_pp": apply_dp_pp_sharding,
+    }
+
+
+def _seq_axis_fits(workflow, n_seq):
+    """Whether every sequence-parallel unit can run over an n_seq-wide
+    seq axis: the shard_map specs need S % n_seq == 0, and Ulysses
+    additionally needs heads % n_seq == 0.  Unlike tp/ep/pp (whose
+    appliers degrade to replicated), an sp unit runs its shard_map
+    unconditionally once the mesh carries the axis — an unvalidated
+    rebuild would crash the next step instead of degrading."""
+    for u in getattr(workflow, "forwards", []):
+        if not getattr(u, "seq_axis", None):
+            continue
+        shape = getattr(getattr(u, "input", None), "shape", None)
+        if shape and len(shape) >= 2 and shape[1] % n_seq:
+            return False
+        if getattr(u, "sp_mode", None) == "ulysses" and \
+                getattr(u, "n_heads", 0) % n_seq:
+            return False
+    return True
+
+
+def _rebuild_styled_mesh(workflow, surviving_devices, n, style):
+    """Re-forms the workflow's non-DP layout over the survivors when
+    divisibility allows; returns the new mesh or None (→ dp
+    fallback).  Every style preserves the OLD data-axis size first
+    (so the model/seq/expert/stage axis — which layer geometry was
+    validated against — shrinks as little as possible), then tries
+    data=2; the non-data axis must keep >= 2 devices or the style is
+    meaningless.
+
+    Host-syncing sharded params during the re-place gathers across
+    the OLD device set — fine while the runtime still serves reads,
+    the documented precondition."""
+    old_mesh = getattr(workflow, "mesh", None)
+    if style[0] in _style_appliers() and len(style) == 3:
+        name, data_axis, other_axis = style
+        old_data = (old_mesh.shape.get(data_axis)
+                    if old_mesh is not None else None)
+        for candidate in (old_data, 2):
+            if candidate and n % candidate == 0 and \
+                    n // candidate >= 2:
+                if name == "dp_sp" and \
+                        not _seq_axis_fits(workflow, n // candidate):
+                    continue
+                mesh = make_mesh(surviving_devices,
+                                 {data_axis: candidate,
+                                  other_axis: n // candidate})
+                kwargs = {"data_axis": data_axis,
+                          {"dp_tp": "model_axis",
+                           "dp_sp": "seq_axis",
+                           "dp_ep": "expert_axis",
+                           "dp_pp": "stage_axis"}[name]: other_axis}
+                _style_appliers()[name](workflow, mesh, **kwargs)
+                return mesh
+        return None
+    if style[0] == "dp_tp_sp" and len(style) == 4:
+        # Preserve the model and seq sizes exactly (both were
+        # validated against layer geometry / sequence length); only
+        # the data axis absorbs the loss.
+        _, data_axis, model_axis, seq_axis = style
+        if old_mesh is None:
+            return None
+        m = old_mesh.shape.get(model_axis)
+        s = old_mesh.shape.get(seq_axis)
+        if not m or not s or n % (m * s) or n // (m * s) < 1 or \
+                not _seq_axis_fits(workflow, s):
+            return None
+        mesh = make_mesh(surviving_devices,
+                         {data_axis: n // (m * s),
+                          model_axis: m, seq_axis: s})
+        apply_dp_tp_sp_sharding(workflow, mesh, data_axis=data_axis,
+                                model_axis=model_axis,
+                                seq_axis=seq_axis)
+        return mesh
+    return None
+
+
 def rebuild_mesh(workflow, surviving_devices=None, axis="data",
                  requeue_in_flight=True):
     """Elastic recovery after chip loss (the mesh-granularity
@@ -320,36 +566,13 @@ def rebuild_mesh(workflow, surviving_devices=None, axis="data",
     n = len(surviving_devices)
     style = getattr(workflow, "_parallel_style_", None) or \
         ("dp", axis)
-    data_size = None
-    if style[0] == "dp_tp":
-        # Preserve the OLD data-axis size when it still divides the
-        # survivor count (so the model axis — which layer widths
-        # were validated against — shrinks as little as possible);
-        # fall back to data=2, then to dp-only.
-        old_mesh = getattr(workflow, "mesh", None)
-        old_data = (old_mesh.shape.get(style[1])
-                    if old_mesh is not None else None)
-        for candidate in (old_data, 2):
-            if candidate and n % candidate == 0 and \
-                    n // candidate >= 2:
-                data_size = candidate
-                break
-    if data_size is not None:
-        # Keep the tensor-parallel layout over the shrunk mesh
-        # (host-syncing model-sharded params gathers across the OLD
-        # device set — fine while the runtime still serves reads,
-        # the documented precondition).
-        mesh = make_mesh(surviving_devices,
-                         {style[1]: data_size,
-                          style[2]: n // data_size})
-        apply_dp_tp_sharding(workflow, mesh, data_axis=style[1],
-                             model_axis=style[2])
-    else:
-        if style[0] == "dp_tp":
+    mesh = _rebuild_styled_mesh(workflow, surviving_devices, n, style)
+    if mesh is None:
+        if style[0] != "dp":
             workflow.warning(
-                "rebuild_mesh: %d survivors cannot hold the 2-axis "
-                "dp×tp layout — falling back to data parallelism"
-                % n)
+                "rebuild_mesh: %d survivors cannot hold the %s "
+                "layout — falling back to data parallelism"
+                % (n, style[0]))
         mesh = make_mesh(surviving_devices, {axis: n})
         apply_dp_sharding(workflow, mesh, axis=axis)
     # The jitted step specialized on the old device set/shardings.

@@ -56,6 +56,19 @@ class Workflow(Container):
         self._queue_ = collections.deque()
         self._run_time_started_ = time.time()
 
+    @property
+    def mesh(self):
+        """The device mesh the parallel appliers bound (TRANSIENT —
+        a jax Mesh holds live Device objects, so it must never ride a
+        snapshot; restore re-applies shardings onto whatever topology
+        exists then, the SURVEY §7 'resume onto a different topology'
+        contract)."""
+        return getattr(self, "_mesh_", None)
+
+    @mesh.setter
+    def mesh(self, value):
+        self._mesh_ = value
+
     # -- ownership ---------------------------------------------------------
 
     @property

@@ -18,9 +18,24 @@ import functools
 
 import numpy
 
+from ..config import root, get as config_get
 from ..memory import Vector
 from .nn_units import ForwardBase, GradientDescentBase
 from .evaluator import EvaluatorBase
+
+
+def remat_enabled(unit_flag):
+    """Whether a transformer unit should rematerialize (jax.checkpoint)
+    its block application: the unit kwarg wins when set, otherwise
+    ``root.common.engine.remat`` (default off).  Remat trades ~1/3 more
+    FLOPs (forward re-run in backward) for O(layers) → O(1) residual
+    activation memory per block — THE long-context/deep-stack enabler:
+    ring attention already gives O(S/N) attention memory, but without
+    remat the backward still stores every block's full residual
+    stream."""
+    if unit_flag is not None:
+        return bool(unit_flag)
+    return bool(config_get(root.common.engine.remat, False))
 
 
 def _layer_norm(x, gamma, beta, eps=1e-5):
@@ -162,6 +177,12 @@ class TransformerBlock(ForwardBase):
             raise ValueError("unknown sp_mode %r — valid: %s" %
                              (self.sp_mode, list(SP_MODES)))
         self.batch_axis = kwargs.get("batch_axis", "data")
+        #: When set (apply_dp_tp_sp_sharding), attention keeps the
+        #: head dim sharded on this mesh axis inside the shard_map —
+        #: the tp × sp composition.
+        self.head_axis = kwargs.get("head_axis")
+        #: None → follow root.common.engine.remat; True/False forces.
+        self.remat = kwargs.get("remat")
         self.params = {name: Vector() for name in self.PARAM_NAMES}
 
     @property
@@ -200,16 +221,22 @@ class TransformerBlock(ForwardBase):
                 self.seq_axis in mesh.axis_names:
             return A.sequence_parallel_attention(
                 q, k, v, mesh, self.seq_axis, causal=self.causal,
-                batch_axis=self.batch_axis, mode=self.sp_mode)
+                batch_axis=self.batch_axis, mode=self.sp_mode,
+                head_axis=getattr(self, "head_axis", None))
         return A.attention(q, k, v, causal=self.causal)
 
     def tforward(self, read, write, params, ctx, state=None):
         x = read(self.input)
-        out = transformer_block_apply(
-            params, x, self.n_heads, self.causal,
-            self.compute_dtype,
-            attend=lambda q, k, v: self._attend(q, k, v))
-        write(self.output, out)
+
+        def apply(p, h):
+            return transformer_block_apply(
+                p, h, self.n_heads, self.causal, self.compute_dtype,
+                attend=lambda q, k, v: self._attend(q, k, v))
+
+        if remat_enabled(getattr(self, "remat", None)):
+            import jax
+            apply = jax.checkpoint(apply)
+        write(self.output, apply(params, x))
 
 
 class MoETransformerBlock(TransformerBlock):
@@ -273,20 +300,35 @@ class MoETransformerBlock(TransformerBlock):
         x = read(self.input)
         B, S, E = x.shape
 
-        def mlp(h):
-            y, aux, load = moe_ffn(
-                h.reshape(B * S, E), params["router"], params["w1"],
-                params["b1"], params["w2"], params["b2"],
-                capacity_factor=self.capacity_factor)
-            ctx.add_aux_loss(self.aux_weight * aux)
-            ctx.add_metric("%s_max_expert_load" % self.name,
-                           load.max() / jnp.maximum(load.sum(), 1.0))
-            return y.reshape(B, S, E)
+        def apply(p, h0):
+            """Pure (params, x) → (out, aux, load): the MoE side
+            outputs RIDE the return value (not ctx closure mutation),
+            so the whole block is checkpointable — a tracer born
+            inside jax.checkpoint must not leak out through ctx."""
+            box = {}
 
-        out = transformer_block_apply(
-            params, x, self.n_heads, self.causal,
-            self.compute_dtype,
-            attend=lambda q, k, v: self._attend(q, k, v), mlp=mlp)
+            def mlp(h):
+                y, aux, load = moe_ffn(
+                    h.reshape(B * S, E), p["router"], p["w1"],
+                    p["b1"], p["w2"], p["b2"],
+                    capacity_factor=self.capacity_factor)
+                box["aux"], box["load"] = aux, load
+                return y.reshape(B, S, E)
+
+            out = transformer_block_apply(
+                p, h0, self.n_heads, self.causal,
+                self.compute_dtype,
+                attend=lambda q, k, v: self._attend(q, k, v),
+                mlp=mlp)
+            return out, box["aux"], box["load"]
+
+        if remat_enabled(getattr(self, "remat", None)):
+            import jax
+            apply = jax.checkpoint(apply)
+        out, aux, load = apply(params, x)
+        ctx.add_aux_loss(self.aux_weight * aux)
+        ctx.add_metric("%s_max_expert_load" % self.name,
+                       load.max() / jnp.maximum(load.sum(), 1.0))
         write(self.output, out)
 
 
@@ -312,6 +354,8 @@ class PipelinedTransformerStack(ForwardBase):
         self.causal = kwargs.get("causal", True)
         self.stage_axis = kwargs.get("stage_axis")
         self.n_microbatches = kwargs.get("n_microbatches", 4)
+        #: None → follow root.common.engine.remat; True/False forces.
+        self.remat = kwargs.get("remat")
         self.params = {name: Vector()
                        for name in TransformerBlock.PARAM_NAMES}
 
@@ -359,6 +403,15 @@ class PipelinedTransformerStack(ForwardBase):
         def block_fn(p, h):
             return transformer_block_apply(p, h, self.n_heads,
                                            self.causal, cdt)
+
+        if remat_enabled(getattr(self, "remat", None)):
+            # Per-BLOCK checkpointing: the pipeline (or the
+            # sequential scan) re-runs each block's forward during
+            # its backward instead of storing every block's
+            # residuals — per-stage activation memory drops from
+            # O(blocks/stage) to O(1) per microbatch in flight.
+            import jax
+            block_fn = jax.checkpoint(block_fn)
 
         mesh = getattr(self.workflow, "mesh", None)
         if self.stage_axis and mesh is not None and \
