@@ -76,6 +76,9 @@ class ModelServer(JsonHttpServer):
 
             def do_POST(self):
                 outer = self.outer
+                if self.path == "/api/generate":
+                    self._generate()
+                    return
                 if self.path != "/api":
                     self.reply(404, {"error": "not found"})
                     return
@@ -98,6 +101,47 @@ class ModelServer(JsonHttpServer):
                     outer.exception("/api forward failed")
                     self.reply(500,
                                {"error": "internal server error"})
+
+            def _generate(self):
+                """POST /api/generate — KV-cache incremental decoding
+                over an LM artifact: {"tokens": [[...]],
+                "max_new_tokens": N, "temperature": T, "seed": S} →
+                {"tokens": full sequences, "generated": new part}.
+                (The deployment surface the reference's RESTful role
+                implies for a language model, restful_api.py:78.)"""
+                outer = self.outer
+                try:
+                    payload = self.read_json()
+                    tokens = numpy.atleast_2d(numpy.asarray(
+                        payload["tokens"], dtype=numpy.int32))
+                    max_new = int(payload.get("max_new_tokens", 32))
+                    if not 1 <= max_new <= 4096:
+                        raise Bug("max_new_tokens out of range")
+                    temperature = float(
+                        payload.get("temperature", 0.0))
+                    seed = int(payload.get("seed", 0))
+                except Exception as e:
+                    outer.warning("bad /api/generate request: %s", e)
+                    self.reply(400, {"error": str(e)})
+                    return
+                try:
+                    full = outer.model.generate(
+                        tokens, max_new, temperature=temperature,
+                        seed=seed)
+                except Bug as e:
+                    # Not-an-LM artifact / over-long request: the
+                    # client's problem, with the reason.
+                    self.reply(400, {"error": str(e)})
+                    return
+                except Exception:
+                    outer.exception("/api/generate failed")
+                    self.reply(500,
+                               {"error": "internal server error"})
+                    return
+                self.reply(200, {
+                    "tokens": full,
+                    "generated": full[:, tokens.shape[1]:],
+                })
 
         super(ModelServer, self).__init__(
             Handler, host=host, port=port,
