@@ -177,3 +177,20 @@ def test_update_forge_requires_server():
     from veles_tpu.scripts.update_forge import UpdateForge
     with pytest.raises(ValueError):
         UpdateForge().run(None, [])
+
+
+def test_generate_docs_covers_units_and_flags(tmp_path):
+    """The generated reference (parity role:
+    docs/generate_units_args.py) must document transformer kwargs,
+    loader kwargs, and the aggregated CLI flags."""
+    from veles_tpu.scripts.generate_docs import generate
+    where, n = generate(str(tmp_path))
+    assert n > 80
+    units = (tmp_path / "units.md").read_text()
+    assert "### TransformerBlock" in units
+    assert "`n_heads`" in units
+    assert "`minibatch_size`" in units
+    assert "**required**" in units  # e.g. Embedding vocab_size
+    cli = (tmp_path / "cli.md").read_text()
+    assert "--random-seed" in cli
+    assert "--frontend" in cli
