@@ -18,10 +18,19 @@ computation per block of ticks), not JPEG decode.
 ``python bench.py --mlp`` runs the secondary MNIST784-MLP bench.
 
 ``python bench.py --lm`` runs the transformer-LM bench (no reference
-counterpart — the reference predates attention): a GPT-small-ish
-causal LM (8 pre-LN blocks, embed 512, 8 heads, seq 512, vocab 8192)
-trained end-to-end through the same fused block step; reports
-tokens/s and MFU against the analytic 6·P + attention FLOP count.
+counterpart — the reference predates attention): a ~640M-param causal
+LM sized to exercise the chip (12 pre-LN blocks, embed 2048, head dim
+128, seq 1024, vocab 16384, per-block remat) trained end-to-end
+through the same fused block step; reports tokens/s and MFU against
+the analytic 6·P + attention FLOP count.  ``--lm-toy`` keeps the
+round-4 GPT-small-ish geometry (8 blocks / embed 512 / seq 512) for
+cross-round continuity.
+
+``python bench.py --streamed-jpeg`` decodes REAL JPEG files (a
+synthetic directory tree written once) through the streamed loader's
+host worker pool — decode + double-buffered upload + fused dispatch
+overlap; reports decode throughput and pipeline_efficiency vs the
+measured bandwidth/decode ceilings.
 
 ``python bench.py --streamed`` runs AlexNet from a NON-resident
 dataset: the streamed loader (loader/stream.py) reads a disk-backed
@@ -74,23 +83,45 @@ ALEXNET_N_VALID = 512
 ALEXNET_TRAIN_GFLOP_PER_IMG = 6.81
 TPU_V5E_PEAK_BF16_TFLOPS = 197.0
 
-# LM bench geometry (GPT-small-ish; attention path headline).
-LM_VOCAB = 8192
-LM_SEQ = 512
-LM_EMBED = 512
-LM_HEADS = 8
-LM_BLOCKS = 8
-LM_BATCH = 16
+# LM bench geometry — sized to EXERCISE the v5e, not to demo the
+# code path (round 4 ran a toy E=512/B=16 net whose 26% MFU was
+# bounded by the tiny contraction dims; VERDICT r4 item 3).  ~640M
+# params (E=2048, 12 pre-LN blocks, head dim 128 — the MXU-native
+# tile width, measured ~15% faster than D=80 —, hidden 4·E, seq
+# 1024, vocab 16384), trained with per-block remat
+# (root.common.engine.remat): without remat the stored attention
+# probabilities alone (L·B·S²·H f32) would exceed HBM.  B=8 measured
+# FASTER than B=16 (37.7% vs 14.7% MFU — the bigger batch pushes the
+# attention transients into HBM pressure).  ``--lm-toy`` keeps the
+# round-4 geometry for continuity.  Tuning table: BENCHNOTES.md
+# "A serious LM bench geometry".
+LM_VOCAB = 16384
+LM_SEQ = 1024
+LM_EMBED = 2048
+LM_HEADS = 16
+LM_BLOCKS = 12
+LM_BATCH = 8
 LM_TICKS_PER_DISPATCH = 8
-LM_N_TRAIN = 2048
-LM_N_VALID = 128
-#: Analytic train cost per token: 6 FLOP/param over the 12·E²-per-
-#: block weights (fwd+bwd+update matmuls) + embeddings, plus the
-#: attention score/value matmuls 12·S·E per layer.
-LM_TRAIN_FLOP_PER_TOKEN = (
-    6.0 * (12 * LM_EMBED * LM_EMBED * LM_BLOCKS +
-           LM_VOCAB * LM_EMBED) +
-    12.0 * LM_SEQ * LM_EMBED * LM_BLOCKS)
+LM_N_TRAIN = 512
+LM_N_VALID = 64
+
+LM_TOY_VOCAB = 8192
+LM_TOY_SEQ = 512
+LM_TOY_EMBED = 512
+LM_TOY_HEADS = 8
+LM_TOY_BLOCKS = 8
+LM_TOY_BATCH = 16
+LM_TOY_N_TRAIN = 2048
+LM_TOY_N_VALID = 128
+
+
+def lm_train_flop_per_token(embed, blocks, seq, vocab):
+    """Analytic train cost per token: 6 FLOP/param over the
+    12·E²-per-block weights (fwd+bwd+update matmuls) + the tied
+    embedding/head projection, plus the attention score/value
+    matmuls 12·S·E per layer."""
+    return (6.0 * (12 * embed * embed * blocks + vocab * embed) +
+            12.0 * seq * embed * blocks)
 
 MLP_BATCH = 100
 MLP_TICKS_PER_DISPATCH = 120
@@ -105,6 +136,21 @@ STREAM_N_TRAIN = 2048
 STREAM_N_VALID = 256
 STREAM_BYTES_PER_IMG = 227 * 227 * 3  # uint8
 
+# Streamed-JPEG mode: REAL image files decoded by the host worker
+# pool (PIL) inside the streamed double-buffer — the reference
+# pipeline's daily reality (veles/loader/fullbatch_image.py:56).
+# The staged samples are float32 (the host normalizer's output), so
+# the tunnel ceiling is 4× lower than the uint8 streamed mode; the
+# figure of merit is still pipeline_efficiency vs the measured
+# ceilings (bandwidth AND decode).
+JPEG_SIZE = 227
+JPEG_CLASSES = 8
+JPEG_TRAIN_PER_CLASS = 96
+JPEG_VALID_PER_CLASS = 16
+JPEG_BATCH = 64
+JPEG_TICKS_PER_DISPATCH = 4
+JPEG_BYTES_PER_IMG = JPEG_SIZE * JPEG_SIZE * 3 * 4  # float32
+
 
 def build_alexnet():
     import veles_tpu.prng as prng
@@ -118,7 +164,11 @@ def build_alexnet():
         ticks_per_dispatch=ALEXNET_TICKS_PER_DISPATCH, max_epochs=1000,
         loader_config={"sim_train": ALEXNET_N_TRAIN,
                        "sim_valid": ALEXNET_N_VALID,
-                       "sim_image_size": 227, "sim_classes": 1000})
+                       "sim_image_size": 227, "sim_classes": 1000,
+                       # Synthetic labels can't cover 1000 classes;
+                       # the analysis warning is dataset QA noise in
+                       # a perf record (VERDICT r4 weak item 7).
+                       "validate_labels": False})
     launcher.initialize()
     return launcher, wf
 
@@ -151,9 +201,12 @@ def build_mlp():
     return launcher, wf
 
 
-def build_lm():
+def build_lm(vocab=LM_VOCAB, seq=LM_SEQ, embed=LM_EMBED,
+             heads=LM_HEADS, blocks=LM_BLOCKS, batch=LM_BATCH,
+             n_train=LM_N_TRAIN, n_valid=LM_N_VALID, remat=True):
     import numpy
     import veles_tpu.prng as prng
+    from veles_tpu.config import root
     from veles_tpu.launcher import Launcher
     from veles_tpu.znicz.samples.tinylm import (FirstTokenLoader,
                                                 TinyLMWorkflow)
@@ -161,20 +214,21 @@ def build_lm():
     class SyntheticCorpus(FirstTokenLoader):
         def load_data(self):
             rng = numpy.random.RandomState(0)
-            n = LM_N_TRAIN + LM_N_VALID
+            n = n_train + n_valid
             self.original_data.mem = rng.randint(
-                0, LM_VOCAB, (n, LM_SEQ)).astype(numpy.int32)
+                0, vocab, (n, seq)).astype(numpy.int32)
             self.original_labels.mem = numpy.roll(
                 self.original_data.mem, -1, axis=1)
-            self.class_lengths = [0, LM_N_VALID, LM_N_TRAIN]
+            self.class_lengths = [0, n_valid, n_train]
 
+    root.common.engine.remat = remat
     prng.reset()
     prng.get(0).seed(42)
     launcher = Launcher()
     wf = TinyLMWorkflow(
-        launcher, vocab_size=LM_VOCAB, seq_len=LM_SEQ,
-        embed_dim=LM_EMBED, n_heads=LM_HEADS, n_blocks=LM_BLOCKS,
-        minibatch_size=LM_BATCH,
+        launcher, vocab_size=vocab, seq_len=seq,
+        embed_dim=embed, n_heads=heads, n_blocks=blocks,
+        minibatch_size=batch,
         ticks_per_dispatch=LM_TICKS_PER_DISPATCH,
         max_epochs=1000, loader_cls=SyntheticCorpus)
     launcher.initialize()
@@ -195,22 +249,130 @@ def build_alexnet_streamed():
         loader_cls=StreamedImagenetLoader,
         loader_config={"sim_train": STREAM_N_TRAIN,
                        "sim_valid": STREAM_N_VALID,
-                       "sim_image_size": 227, "sim_classes": 1000})
+                       "sim_image_size": 227, "sim_classes": 1000,
+                       "validate_labels": False})
     launcher.initialize()
     return launcher, wf
 
 
-def measure_upload_bandwidth(repeats=3):
+def make_jpeg_tree(base):
+    """Writes the synthetic JPEG directory tree ONCE (class
+    subdirectories of per-class-tinted photos-ish noise) and returns
+    (train_dirs, valid_dirs).  Per-class deterministic RNG, and a
+    stale directory (wrong file count from an earlier config) is
+    cleared before regeneration — the loader scans directories, so
+    leftovers would silently change the dataset."""
+    import shutil
+    import numpy
+    from PIL import Image
+    made = []
+    for si, (split, per) in enumerate((
+            ("train", JPEG_TRAIN_PER_CLASS),
+            ("valid", JPEG_VALID_PER_CLASS))):
+        dirs = []
+        for cls in range(JPEG_CLASSES):
+            d = os.path.join(base, split, "class%02d" % cls)
+            dirs.append(d)
+            if os.path.isdir(d):
+                if len(os.listdir(d)) == per:
+                    continue
+                shutil.rmtree(d)
+            os.makedirs(d, exist_ok=True)
+            rng = numpy.random.RandomState(1000 * si + cls)
+            tint = rng.randint(0, 255, 3)
+            for i in range(per):
+                arr = numpy.clip(
+                    rng.normal(tint, 40, (256, 256, 3)), 0,
+                    255).astype(numpy.uint8)
+                Image.fromarray(arr).save(
+                    os.path.join(d, "%04d.jpg" % i), quality=85)
+        made.append(dirs)
+    return made[0], made[1]
+
+
+def build_jpeg_streamed(train_dirs, valid_dirs):
+    """A compact conv net over the streamed JPEG directory (the model
+    is deliberately small — through the tunnel this bench is
+    IO-bound by design; the measurement is the PIPELINE, decode +
+    upload + dispatch overlap)."""
+    import veles_tpu.prng as prng
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.loader.image import StreamedFileImageLoader
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    prng.reset()
+    prng.get(0).seed(42)
+    launcher = Launcher()
+    gd = {"learning_rate": 0.01, "gradient_moment": 0.9}
+    wf = StandardWorkflow(
+        launcher,
+        layers=[
+            {"type": "conv_str",
+             "->": {"n_kernels": 32, "kx": 7, "ky": 7,
+                    "sliding": (4, 4)}, "<-": dict(gd)},
+            {"type": "max_pooling", "->": {"kx": 3, "ky": 3,
+                                           "sliding": (2, 2)}},
+            {"type": "conv_str",
+             "->": {"n_kernels": 64, "kx": 3, "ky": 3,
+                    "padding": 1}, "<-": dict(gd)},
+            {"type": "max_pooling", "->": {"kx": 3, "ky": 3,
+                                           "sliding": (2, 2)}},
+            {"type": "softmax",
+             "->": {"output_sample_shape": (JPEG_CLASSES,)},
+             "<-": dict(gd)},
+        ],
+        loader_cls=StreamedFileImageLoader,
+        loader_config={
+            "minibatch_size": JPEG_BATCH,
+            "train_paths": train_dirs,
+            "validation_paths": valid_dirs,
+            "size": (JPEG_SIZE, JPEG_SIZE),
+            "normalization_type": "linear"},
+        loss_function="softmax",
+        decision_config={"max_epochs": 1000},
+        ticks_per_dispatch=JPEG_TICKS_PER_DISPATCH)
+    launcher.initialize()
+    return launcher, wf
+
+
+def measure_decode_throughput(loader, n=256):
+    """Raw host decode+normalize rate of the worker pool (no device
+    involvement): images/sec over one staged block of n samples."""
+    import numpy
+    idxs = numpy.tile(
+        numpy.arange(sum(loader.class_lengths[:2]),
+                     sum(loader.class_lengths[:2]) + min(
+                         n, loader.class_lengths[2]),
+                     dtype=numpy.int32), (1, 1))
+    masks = numpy.ones_like(idxs, dtype=numpy.float32)
+    loader._fill_block(idxs, masks)  # warm the pool
+    t0 = time.time()
+    loader._fill_block(idxs, masks)
+    dt = time.time() - t0
+    return idxs.shape[1] / dt
+
+
+def measure_upload_bandwidth(repeats=3, shape=None, dtype=None):
     """Host→device throughput of a representative streamed block
-    chunk (one minibatch of uint8 images)."""
+    chunk.  The payload must MATCH the mode's real staged blocks
+    (shape AND dtype): per-transfer roundtrip overhead amortizes with
+    payload size, so probing with a smaller/other-dtype buffer than
+    the run stages biases the ceiling and can push the efficiency
+    ratio past 1.0."""
     import jax
     import jax.numpy as jnp
     import numpy
-    x = numpy.random.randint(
-        0, 255, size=(STREAM_BATCH, 227, 227, 3), dtype=numpy.uint8)
+    if shape is None:
+        shape = (STREAM_BATCH, 227, 227, 3)
+    if dtype is None:
+        dtype = numpy.uint8
+    if dtype == numpy.uint8:
+        x = numpy.random.randint(0, 255, size=shape,
+                                 dtype=numpy.uint8)
+    else:
+        x = numpy.random.rand(*shape).astype(dtype)
 
     def sync(a):
-        numpy.array(jax.device_get(jnp.sum(a[0, 0, 0])))
+        numpy.array(jax.device_get(jnp.sum(a[(0,) * a.ndim])))
 
     sync(jax.device_put(x))  # warmup
     t0 = time.time()
@@ -254,6 +416,44 @@ def measure(wf, epochs):
 
 
 def main():
+    if "--streamed-jpeg" in sys.argv:
+        base = os.environ.get(
+            "VELES_JPEG_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_jpeg"))
+        train_dirs, valid_dirs = make_jpeg_tree(base)
+        import numpy as _np
+        jpeg_block = (JPEG_TICKS_PER_DISPATCH * JPEG_BATCH,
+                      JPEG_SIZE, JPEG_SIZE, 3)
+        bw_before = measure_upload_bandwidth(shape=jpeg_block,
+                                             dtype=_np.float32)
+        _, wf = build_jpeg_streamed(train_dirs, valid_dirs)
+        decode_ips = measure_decode_throughput(wf.loader)
+        ips = measure(wf, epochs=2)
+        # The tunnel's bandwidth drifts minute-to-minute; probing
+        # only before the run can understate the ceiling and report
+        # efficiency > 1.  Probe again after and use the max.
+        bw = max(bw_before, measure_upload_bandwidth(
+            shape=jpeg_block, dtype=_np.float32))
+        bw_ceiling = bw / JPEG_BYTES_PER_IMG
+        ceiling = min(bw_ceiling, decode_ips)
+        print(json.dumps({
+            "metric": "jpeg_streamed_train_images_per_sec",
+            "value": round(ips, 1),
+            "unit": "images/sec",
+            # The model here is a deliberately small conv net (the
+            # bench is IO-bound by design), so an AlexNet throughput
+            # ratio would be meaningless: the figure of merit IS the
+            # pipeline efficiency vs the measured ceilings.
+            "vs_baseline": round(ips / ceiling, 4),
+            "vs_baseline_meaning": "pipeline_efficiency_vs_ceiling",
+            "upload_gbps": round(bw / 1e9, 4),
+            "upload_gbps_before": round(bw_before / 1e9, 4),
+            "decode_images_per_sec": round(decode_ips, 1),
+            "bw_ceiling_images_per_sec": round(bw_ceiling, 1),
+            "pipeline_efficiency": round(ips / ceiling, 4),
+        }))
+        return
     if "--streamed" in sys.argv:
         bw = measure_upload_bandwidth()
         bw_ceiling = bw / STREAM_BYTES_PER_IMG
@@ -269,18 +469,38 @@ def main():
             "pipeline_efficiency": round(ips / bw_ceiling, 4),
         }))
         return
-    if "--lm" in sys.argv:
-        _, wf = build_lm()
+    if "--lm" in sys.argv or "--lm-toy" in sys.argv:
+        toy = "--lm-toy" in sys.argv
+        if toy:
+            geom = dict(vocab=LM_TOY_VOCAB, seq=LM_TOY_SEQ,
+                        embed=LM_TOY_EMBED, heads=LM_TOY_HEADS,
+                        blocks=LM_TOY_BLOCKS, batch=LM_TOY_BATCH,
+                        n_train=LM_TOY_N_TRAIN,
+                        n_valid=LM_TOY_N_VALID, remat=False)
+            _, wf = build_lm(**geom)
+        else:
+            # The default geometry lives ONCE in build_lm's defaults
+            # (the LM_* constants); geom here only feeds the FLOP
+            # accounting below.
+            geom = dict(vocab=LM_VOCAB, seq=LM_SEQ, embed=LM_EMBED,
+                        blocks=LM_BLOCKS, n_train=LM_N_TRAIN,
+                        n_valid=LM_N_VALID)
+            _, wf = build_lm()
         ips = measure(wf, epochs=2)
-        tokens_per_sec = ips * LM_SEQ
+        tokens_per_sec = ips * geom["seq"]
         # Validation sequences run forward-only (~1/3 of the train
         # FLOP cost); weight them accordingly in the FLOP accounting.
-        n_total = LM_N_TRAIN + LM_N_VALID
-        flop_weight = (LM_N_TRAIN + LM_N_VALID / 3.0) / n_total
-        tflops = tokens_per_sec * flop_weight *             LM_TRAIN_FLOP_PER_TOKEN / 1e12
+        n_total = geom["n_train"] + geom["n_valid"]
+        flop_weight = (geom["n_train"] + geom["n_valid"] / 3.0) / \
+            n_total
+        flop_per_token = lm_train_flop_per_token(
+            geom["embed"], geom["blocks"], geom["seq"],
+            geom["vocab"])
+        tflops = tokens_per_sec * flop_weight * flop_per_token / 1e12
         mfu = tflops / TPU_V5E_PEAK_BF16_TFLOPS
         print(json.dumps({
-            "metric": "tinylm_gpt_small_train_tokens_per_sec",
+            "metric": "tinylm_gpt_small_train_tokens_per_sec" if toy
+            else "lm_640m_remat_train_tokens_per_sec",
             "value": round(tokens_per_sec, 1),
             "unit": "tokens/sec",
             # No reference LM baseline exists (the reference predates
@@ -304,7 +524,13 @@ def main():
         return
     _, wf = build_alexnet()
     ips = measure(wf, epochs=2)
-    tflops = ips * ALEXNET_TRAIN_GFLOP_PER_IMG / 1000.0
+    # Validation images run forward-only (~1/3 of the train FLOP
+    # cost) — weight them like the LM bench does instead of billing
+    # every served image at the full train cost (VERDICT r4 weak
+    # item 2: the old accounting overstated TFLOP/s by ~2%).
+    n_total = ALEXNET_N_TRAIN + ALEXNET_N_VALID
+    flop_weight = (ALEXNET_N_TRAIN + ALEXNET_N_VALID / 3.0) / n_total
+    tflops = ips * flop_weight * ALEXNET_TRAIN_GFLOP_PER_IMG / 1000.0
     print(json.dumps({
         "metric": "alexnet_train_images_per_sec",
         "value": round(ips, 1),

@@ -306,6 +306,11 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
                 # Token-frequency skew is normal language statistics,
                 # not a dataset bug — no imbalance warnings.
                 self.debug("%s (per-token)", msg)
+            elif not self.validate_labels:
+                # The user declared these are not real class labels
+                # (synthetic benches, ids): stats stay available but
+                # imbalance is not a warning-worthy dataset bug.
+                self.info("%s", msg)
             elif std > mean / 2:
                 self.warning("%s — SEVERELY imbalanced", msg)
             elif std > mean / 10:

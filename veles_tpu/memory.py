@@ -25,6 +25,7 @@ control plane to describe tensors without shipping them
 (reference memory.py:290-299).
 """
 
+import contextlib
 import threading
 
 import numpy
@@ -32,6 +33,27 @@ import numpy
 from .distributable import Pickleable
 
 _accounting_lock = threading.Lock()
+
+#: When set (host_resharding context), sharding changes take the
+#: host-sync path unconditionally.  Elastic rebuild needs this: a
+#: device-to-device reshard sourced from a partially-departed device
+#: set may fail ASYNCHRONOUSLY (the transfer enqueues and returns
+#: before touching the dead chip), which a try/except cannot catch —
+#: while the host path reads one healthy replica shard and always
+#: recovers.
+_force_host_reshard = threading.local()
+
+
+@contextlib.contextmanager
+def host_resharding():
+    """Forces sharding changes inside the block to round-trip through
+    the host (see :attr:`_force_host_reshard`)."""
+    prev = getattr(_force_host_reshard, "on", False)
+    _force_host_reshard.on = True
+    try:
+        yield
+    finally:
+        _force_host_reshard.on = prev
 
 
 class Vector(Pickleable):
@@ -151,12 +173,37 @@ class Vector(Pickleable):
     @sharding.setter
     def sharding(self, value):
         with self._lock_:
-            if value is not self._sharding:
-                self._sharding = value
-                # Resharding requires re-upload.
-                if self._devmem_ is not None:
-                    self._host_sync()
-                    self._free_device()
+            if value is self._sharding:
+                return
+            self._sharding = value
+            if self._devmem_ is None:
+                return
+            if not self._host_stale_:
+                # Host copy is current: just drop the device copy and
+                # re-upload lazily under the new layout.
+                self._free_device()
+                return
+            # Device copy is authoritative.  Reshard DEVICE-TO-DEVICE
+            # when possible (jax.device_put between shardings) — a
+            # host round-trip for e.g. a 2.5 GB momentum tensor costs
+            # minutes through a slow link for no reason.  NOT under
+            # host_resharding(): elastic rebuild forces the host path
+            # there, because a D2D transfer sourced from a
+            # partially-departed device set can fail ASYNCHRONOUSLY
+            # (enqueue-then-die), which no try/except here can catch,
+            # while the host path reads one healthy replica shard.
+            if value is not None and \
+                    not getattr(_force_host_reshard, "on", False):
+                try:
+                    import jax
+                    arr = jax.device_put(self._devmem_, value)
+                except Exception:
+                    arr = None
+                if arr is not None:
+                    self.devmem = arr
+                    return
+            self._host_sync()
+            self._free_device()
 
     def initialize(self, device):
         """Attaches to a device; upload is lazy (reference:

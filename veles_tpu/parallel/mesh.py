@@ -561,20 +561,27 @@ def rebuild_mesh(workflow, surviving_devices=None, axis="data",
     in-process path.
     """
     import jax
+    from ..memory import host_resharding
     if surviving_devices is None:
         surviving_devices = jax.devices()
     n = len(surviving_devices)
     style = getattr(workflow, "_parallel_style_", None) or \
         ("dp", axis)
-    mesh = _rebuild_styled_mesh(workflow, surviving_devices, n, style)
-    if mesh is None:
-        if style[0] != "dp":
-            workflow.warning(
-                "rebuild_mesh: %d survivors cannot hold the %s "
-                "layout — falling back to data parallelism"
-                % (n, style[0]))
-        mesh = make_mesh(surviving_devices, {axis: n})
-        apply_dp_sharding(workflow, mesh, axis=axis)
+    # Recovery context: every re-placement must round-trip through
+    # the host (reads a healthy replica shard) — a device-to-device
+    # reshard sourced from the departed chips could fail
+    # asynchronously past any except clause.
+    with host_resharding():
+        mesh = _rebuild_styled_mesh(workflow, surviving_devices, n,
+                                    style)
+        if mesh is None:
+            if style[0] != "dp":
+                workflow.warning(
+                    "rebuild_mesh: %d survivors cannot hold the %s "
+                    "layout — falling back to data parallelism"
+                    % (n, style[0]))
+            mesh = make_mesh(surviving_devices, {axis: n})
+            apply_dp_sharding(workflow, mesh, axis=axis)
     # The jitted step specialized on the old device set/shardings.
     workflow.compiler._compiled = False
     loader = getattr(workflow, "loader", None)

@@ -253,6 +253,15 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
             for attr, vec in self.target.trainables.items():
                 slot = "velocity_" + attr
                 if slot not in self._velocities:
+                    # Host-zeros init, uploaded lazily.  Creating the
+                    # zeros ON DEVICE (jnp.zeros, jitted or eager)
+                    # was tried in r5 and REVERTED: on the axon
+                    # runtime such arrays are materialized host-side
+                    # and re-staged through the tunnel when the first
+                    # training dispatch consumes them — the same
+                    # params-sized transfer, relocated INTO the
+                    # measured window (a 10× apparent bench
+                    # regression; see BENCHNOTES.md).
                     v = Vector(numpy.zeros(vec.shape, dtype=vec.dtype))
                     v.initialize(self.device)
                     self._velocities[slot] = v
