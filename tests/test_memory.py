@@ -107,3 +107,70 @@ def test_shallow_pickle_preserves_metadata(device):
     assert v2.mem is None
     assert v2.shape == (3, 4)
     assert v2.dtype == numpy.float32
+
+
+def test_d2d_reshard_preserves_device_values():
+    """Sharding a device-authoritative Vector must move the DEVICE
+    values (device-to-device) — not resurrect a stale host copy —
+    and place them across the new layout."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from veles_tpu.parallel import make_mesh
+    mesh = make_mesh(jax.devices(), {"data": 8})
+    v = Vector(numpy.zeros((8, 4), dtype=numpy.float32))
+    v.devmem = v.devmem + 7.0  # device authoritative, host stale
+    v.sharding = NamedSharding(mesh, PartitionSpec("data"))
+    got = numpy.asarray(jax.device_get(v.devmem))
+    assert (got == 7.0).all()
+    assert len(v.devmem.sharding.device_set) == 8
+
+
+def test_host_resharding_context_forces_host_path():
+    """Under host_resharding() the device copy is synced to host and
+    freed — the elastic-rebuild recovery contract (a D2D transfer
+    from departed chips could fail asynchronously)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from veles_tpu.memory import host_resharding
+    from veles_tpu.parallel import make_mesh
+    mesh = make_mesh(jax.devices(), {"data": 8})
+    v = Vector(numpy.zeros((8, 4), dtype=numpy.float32))
+    v.devmem = v.devmem + 3.0
+    with host_resharding():
+        v.sharding = NamedSharding(mesh, PartitionSpec("data"))
+        # The host copy was refreshed and is now authoritative.
+        assert v._mem is not None and (v._mem == 3.0).all()
+    got = numpy.asarray(jax.device_get(v.devmem))
+    assert (got == 3.0).all()
+    assert len(v.devmem.sharding.device_set) == 8
+
+
+def test_sharding_change_with_current_host_copy_skips_transfers():
+    """When the host copy is already current, resharding must not
+    touch the device at all (free + lazy re-upload)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    import jax
+    from veles_tpu.parallel import make_mesh
+    mesh = make_mesh(jax.devices(), {"data": 8})
+    v = Vector(numpy.full((8, 2), 2.0, dtype=numpy.float32))
+    _ = v.devmem
+    v.map_read()  # host synced, device still present
+    v.sharding = NamedSharding(mesh, PartitionSpec("data"))
+    assert v._devmem_ is None  # freed, not resharded eagerly
+    assert (numpy.asarray(jax.device_get(v.devmem)) == 2.0).all()
+
+
+def test_sharding_unpicklable_never_rides_snapshots():
+    """_sharding is topology-bound (live Device objects): pickling a
+    sharded Vector must drop it and keep the data."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    from veles_tpu.parallel import make_mesh
+    mesh = make_mesh(jax.devices(), {"data": 8})
+    v = Vector(numpy.arange(8, dtype=numpy.float32))
+    v.sharding = NamedSharding(mesh, PartitionSpec("data"))
+    _ = v.devmem
+    v2 = pickle.loads(pickle.dumps(v))
+    assert v2.sharding is None
+    assert numpy.array_equal(v2.mem, numpy.arange(8,
+                                                  dtype=numpy.float32))

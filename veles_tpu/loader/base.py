@@ -321,8 +321,10 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         # differs wildly from training skews the reported metrics
         # (reference _compare_label_distributions); token mixes of
         # sequence targets are expected to drift — skip the whole
-        # computation there.
-        if train_hist and not sequence_labels:
+        # computation there, and for declared non-class labels
+        # (validate_labels=False) drift is not a dataset bug either.
+        if train_hist and not sequence_labels and \
+                self.validate_labels:
             total_train = sum(train_hist.values())
             for cls in (TEST, VALID):
                 hist = histograms.get(cls)
