@@ -285,8 +285,6 @@ def make_jpeg_tree(base):
     if stale:
         shutil.rmtree(base, ignore_errors=True)
     os.makedirs(base, exist_ok=True)
-    with open(marker, "w") as fout:
-        json.dump(config, fout)
     made = []
     for si, (split, per) in enumerate((
             ("train", JPEG_TRAIN_PER_CLASS),
@@ -302,13 +300,20 @@ def make_jpeg_tree(base):
             os.makedirs(d, exist_ok=True)
             rng = numpy.random.RandomState(1000 * si + cls)
             tint = rng.randint(0, 255, 3)
+            src = config["src_size"]
             for i in range(per):
                 arr = numpy.clip(
-                    rng.normal(tint, 40, (256, 256, 3)), 0,
+                    rng.normal(tint, config["sigma"],
+                               (src, src, 3)), 0,
                     255).astype(numpy.uint8)
                 Image.fromarray(arr).save(
-                    os.path.join(d, "%04d.jpg" % i), quality=85)
+                    os.path.join(d, "%04d.jpg" % i),
+                    quality=config["quality"])
         made.append(dirs)
+    # Marker LAST: an interrupted generation must never leave a
+    # marker vouching for a partial tree (the next run will rebuild).
+    with open(marker, "w") as fout:
+        json.dump(config, fout)
     return made[0], made[1]
 
 

@@ -131,3 +131,37 @@ def test_generate_endpoint(lm_model):
         assert "positional" in out["error"]
     finally:
         server.stop()
+
+
+def test_pipelined_stack_exports_and_generates(tmp_path):
+    """A pipeline-parallel-trained LM deploys like any other: the
+    stage-stacked parameters unstack into ordinary transformer_block
+    entries, and the artifact serves forward AND KV-cache decode."""
+    from veles_tpu.znicz.samples.tinylm import TinyLMWorkflow
+    prng.reset()
+    prng.get(0).seed(3)
+    launcher = Launcher()
+    wf = TinyLMWorkflow(launcher, pipelined=True, n_blocks=2,
+                        n_microbatches=2, max_epochs=8)
+    launcher.initialize()
+    launcher.run()
+    assert wf.decision.min_validation_err < 0.05
+    path = str(tmp_path / "pp.veles.tgz")
+    export_workflow(wf, path)
+    model = ExportedModel(path)
+    assert [u["type"] for u in model.units] == \
+        ["embedding", "transformer_block", "transformer_block",
+         "lm_head"]
+    # The exported chain still solves the recall task...
+    x = numpy.random.RandomState(0).randint(
+        0, 16, (4, 32)).astype(numpy.float32)
+    pred = numpy.argmax(model.forward(x), -1)
+    assert (pred == x[:, :1].astype(int)).mean() == 1.0
+    # ...and decodes with the KV cache, at parity with the forward.
+    full, logits = model.generate(x[:, :8].astype(numpy.int32), 4,
+                                  return_logits=True)
+    ref = numpy.asarray(
+        model.forward(full[:, :8].astype(numpy.float32)))[:, -1]
+    numpy.testing.assert_allclose(logits[:, 0], ref, rtol=2e-4,
+                                  atol=2e-4)
+    assert (full[:, 8:] == x[:, :1].astype(numpy.int32)).all()

@@ -185,22 +185,49 @@ def forward_chain(workflow):
     return chain
 
 
+def _expand_unit(unit):
+    """One forward unit → one or more (entry, params) pairs.  A
+    pipelined transformer stack (stage-stacked parameters with a
+    leading n_blocks dim) UNSTACKS into n_blocks ordinary
+    transformer_block entries — the pipeline is a TRAINING layout,
+    not an inference format, so a stack trained under dp×pp deploys
+    through the same artifact/native/REST surfaces as a sequential
+    model (sequential and pipelined are bit-identical by
+    construction, ops/pipeline.py)."""
+    from .znicz.attention import PipelinedTransformerStack
+    if not isinstance(unit, PipelinedTransformerStack):
+        return [_unit_entry(unit)]
+    out = []
+    for i in range(unit.n_blocks):
+        params = {}
+        for pname, vec in unit.trainables.items():
+            vec.map_read()
+            params[pname] = numpy.ascontiguousarray(
+                numpy.asarray(vec.mem, dtype=numpy.float32)[i])
+        entry = {"name": "%s_block%d" % (unit.name, i),
+                 "type": "transformer_block",
+                 "config": {"n_heads": unit.n_heads,
+                            "causal": int(unit.causal)}}
+        out.append((entry, params))
+    return out
+
+
 def export_workflow(workflow, path):
     """Writes the inference artifact for a trained workflow."""
     chain = forward_chain(workflow)
     units = []
     weight_arrays = {}
     for unit in chain:
-        entry, params = _unit_entry(unit)
-        entry["params"] = {}
-        for pname, arr in params.items():
-            key = "%s__%s" % (entry["name"], pname)
-            if key in weight_arrays:
-                raise Bug("duplicate weight key %r — unit names in "
-                          "the chain must be unique" % key)
-            weight_arrays[key] = arr
-            entry["params"][pname] = key
-        units.append(entry)
+        for entry, params in _expand_unit(unit):
+            entry["params"] = {}
+            for pname, arr in params.items():
+                key = "%s__%s" % (entry["name"], pname)
+                if key in weight_arrays:
+                    raise Bug("duplicate weight key %r — unit names "
+                              "in the chain must be unique" % key)
+                weight_arrays[key] = arr
+                entry["params"][pname] = key
+            units.append(entry)
     for entry in units:
         shape = entry["config"].get("output_sample_shape")
         if shape is not None and len(shape) > 1:
