@@ -28,13 +28,22 @@ if "jax" in sys.modules:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running end-to-end tests excluded from tier-1")
+
+
 @pytest.fixture(autouse=True)
 def _reset_prng():
-    """Deterministic generators per test."""
+    """Deterministic generators + clean resilience state per test."""
     import veles_tpu.prng as prng
+    import veles_tpu.resilience as resilience
     prng.reset()
+    resilience.reset()
     yield
     prng.reset()
+    resilience.reset()
 
 
 @pytest.fixture

@@ -1,0 +1,128 @@
+"""Full-stack chaos: distributed MNIST training surviving worker
+churn AND a coordinator kill, resuming from the atomic snapshot, and
+still converging.  Slow by design (real training on both sides of the
+crash) — tier-1 covers the same machinery with the fast ledger tests
+in test_resilience.py."""
+
+import threading
+import time
+
+import pytest
+
+import veles_tpu.prng as prng
+import veles_tpu.resilience as resilience
+from veles_tpu.client import Client
+from veles_tpu.launcher import Launcher
+from veles_tpu.resilience import FaultInjector
+from veles_tpu.server import Server
+from veles_tpu.snapshotter import SnapshotterToFile
+from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+pytestmark = pytest.mark.slow
+
+
+class SnappingMnist(MnistWorkflow):
+    """MNIST master that requests a coordinated snapshot after every
+    applied update (the snapshotter defers while jobs are in flight
+    and exports on drain — the crash-resume source of truth)."""
+
+    def apply_data_from_slave(self, data, slave=None):
+        super(SnappingMnist, self).apply_data_from_slave(data, slave)
+        snap = getattr(self, "snap", None)
+        if snap is not None:
+            snap.run()
+
+
+def _build(seed, **kwargs):
+    kwargs.setdefault("max_epochs", 4)
+    kwargs.setdefault("learning_rate", 0.1)
+    kwargs.setdefault("gradient_moment", 0.5)
+    prng.reset()
+    prng.get(0).seed(seed)
+    launcher = Launcher()
+    wf = SnappingMnist(launcher, **kwargs)
+    launcher.initialize()
+    return launcher, wf
+
+
+def _start_worker(addr, injector=None):
+    _, slave = _build(77)
+    client = Client(addr, slave, injector=injector,
+                    reconnect_attempts=300, reconnect_delay=0.05)
+    thread = threading.Thread(target=client.run, daemon=True)
+    thread.start()
+    return client, thread
+
+
+def test_mnist_worker_churn_and_master_kill_resumes_and_converges(
+        tmp_path):
+    # -- first life: two workers, one dies mid-run; the coordinator
+    # is killed at its 40th job serve.
+    _, master = _build(77)
+    snap = SnapshotterToFile(master, directory=str(tmp_path),
+                             prefix="mnist", time_interval=0.2,
+                             compression="")
+    snap.initialize()
+    master.snap = snap
+    master_injector = FaultInjector("master.crash@job:40,seed:42")
+    server = Server(":0", master, injector=master_injector)
+    port = server.port
+    addr = "127.0.0.1:%d" % port
+    churn_injector = FaultInjector("worker.kill@job:10,seed:42")
+    client_a, thread_a = _start_worker(addr, injector=churn_injector)
+    client_b, thread_b = _start_worker(addr)
+    server.wait(timeout=300)
+    assert server.crashed
+    assert churn_injector.fired == [("worker.kill", "job", 10)]
+    assert resilience.stats.get("client.death") == 1
+    assert resilience.stats.get("snapshot.write") >= 1
+
+    # -- second life: a restarted coordinator adopts the newest
+    # atomic snapshot on the SAME port; both workers are still
+    # dialing and reconnect on their own.
+    relauncher = Launcher()
+    resumed = relauncher.resume_latest(directory=str(tmp_path),
+                                       prefix="mnist")
+    assert resumed is not None
+    relauncher.initialize()
+    assert resilience.stats.get("master.resume") == 1
+    server2 = Server(("127.0.0.1", port), resumed)
+    server2.wait(timeout=600)
+    assert not server2.is_running and not server2.crashed
+    for client, thread in ((client_a, thread_a),
+                           (client_b, thread_b)):
+        client.stop()
+        thread.join(timeout=15)
+
+    # Training completed across the crash: every epoch closed (the
+    # job ledger released every in-flight bucket — a lost or
+    # double-counted minibatch would wedge an epoch boundary or
+    # corrupt the metrics), and the model still converged.
+    assert bool(resumed.decision.complete)
+    assert resumed.decision.epoch_number == 4
+    assert resumed.total_inflight_jobs() == 0
+    assert resumed.decision.min_validation_err < 0.3
+
+
+def test_mnist_probabilistic_death_still_completes():
+    """The legacy --slave-death-probability path, now routed through
+    the injector: a worker with a 5% per-job death chance (seeded)
+    keeps dying and rejoining, and training still completes with
+    correct epoch accounting."""
+    _, master = _build(33, max_epochs=3)
+    server = Server(":0", master)
+    addr = "127.0.0.1:%d" % server.port
+    _, slave = _build(33, max_epochs=3)
+    client = Client(addr, slave, death_probability=0.05,
+                    reconnect_attempts=300, reconnect_delay=0.05)
+    thread = threading.Thread(target=client.run, daemon=True)
+    thread.start()
+    deadline = time.time() + 300
+    while server.is_running and time.time() < deadline:
+        server.wait(timeout=1.0)
+    assert not server.is_running
+    client.stop()
+    thread.join(timeout=15)
+    assert bool(master.decision.complete)
+    assert master.decision.epoch_number == 3
+    assert master.total_inflight_jobs() == 0

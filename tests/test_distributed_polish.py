@@ -137,8 +137,13 @@ def test_periodic_power_remeasure(monkeypatch):
     master.job_limit = 4
     server = Server(":0", master)
     slave = InstrumentedWorkflow(Launcher())
+    # reconnect_attempts bounds the run: the final power report can
+    # race the server's post-completion close, and a client dialing a
+    # stopped server would otherwise sit out the full crash-resume
+    # backoff schedule (minutes) synchronously.
     client = Client("127.0.0.1:%d" % server.port, slave,
-                    measure_power=True, power_interval=0.0)
+                    measure_power=True, power_interval=0.0,
+                    reconnect_attempts=1)
     seen = []
     orig_apply = server._apply_update
 

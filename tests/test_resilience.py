@@ -1,0 +1,609 @@
+"""Resilience-layer tests: retry/backoff policies, deterministic
+fault injection, frame-size bounds, atomic snapshots, the watchdog
+blacklist→requeue path, and coordinator crash-resume with an
+exactly-once job ledger (fast, tier-1; the full MNIST churn test
+lives in test_chaos_e2e.py, marked slow)."""
+
+import gzip
+import os
+import pickle
+import socket
+import threading
+import time
+
+import pytest
+
+import veles_tpu.prng as prng
+import veles_tpu.resilience as resilience
+from veles_tpu.client import Client
+from veles_tpu.launcher import Launcher
+from veles_tpu.network_common import (_HEADER, connect, recv_message,
+                                      send_message)
+from veles_tpu.resilience import (Deadline, FaultInjector,
+                                  InjectedNetworkFault, MasterCrash,
+                                  RetryPolicy, SnapshotWriteFault,
+                                  WorkerHang, WorkerKilled,
+                                  latest_snapshot)
+from veles_tpu.server import Server
+from veles_tpu.snapshotter import SnapshotterToFile
+from veles_tpu.units import TrivialUnit
+from veles_tpu.workflow import Workflow
+
+
+# -- RetryPolicy / Deadline ------------------------------------------------
+
+def test_retry_policy_backoff_deterministic():
+    policy = RetryPolicy(max_attempts=5, base_delay=0.1, factor=2.0,
+                         max_delay=0.5, jitter=0.25)
+    first = list(policy.delays())
+    prng.reset()
+    second = list(policy.delays())
+    assert first == second  # seeded jitter replays exactly
+    # Exponential shape, capped: ±25% prng jitter × ±12.5% stable
+    # per-process phase (herd desynchronization).
+    assert 0.065 <= first[0] <= 0.141
+    assert all(d <= 0.5 * 1.25 * 1.125 for d in first)
+
+
+def test_retry_policy_call_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.0, jitter=0.0)
+    assert policy.call(flaky, stat="test.retry") == "ok"
+    assert len(calls) == 3
+    assert resilience.stats.get("test.retry") == 2
+
+
+def test_retry_policy_exhaustion_raises():
+    policy = RetryPolicy(max_attempts=2, base_delay=0.0, jitter=0.0)
+    with pytest.raises(OSError):
+        policy.call(lambda: (_ for _ in ()).throw(OSError("nope")))
+
+
+def test_deadline():
+    d = Deadline(0.05)
+    assert not d.expired
+    assert d.clamp(100.0) <= 0.05
+    time.sleep(0.06)
+    assert d.expired
+    assert Deadline(None).remaining() == float("inf")
+
+
+# -- FaultInjector ---------------------------------------------------------
+
+def test_chaos_plan_parses_seed_and_rules():
+    fi = FaultInjector("net.drop@job:7, worker.kill@job:12, seed:42")
+    assert fi.seed == 42
+    assert fi.active
+    assert not FaultInjector().active
+
+
+def test_chaos_plan_rejects_unknown():
+    with pytest.raises(ValueError):
+        FaultInjector("warp.core@job:1")
+    with pytest.raises(ValueError):
+        FaultInjector("net.drop")
+
+
+def test_one_shot_rule_fires_once_at_counter():
+    fi = FaultInjector("worker.kill@job:3")
+    for _ in range(2):
+        fi.tick("job")
+        fi.check("worker.job")  # below threshold: no fault
+    fi.tick("job")
+    with pytest.raises(WorkerKilled):
+        fi.check("worker.job")
+    fi.check("worker.job")  # one-shot: never again
+    assert fi.fired == [("worker.kill", "job", 3)]
+
+
+def test_own_point_counter_rule():
+    fi = FaultInjector("net.drop@2")  # 2nd check of net.send
+    fi.check("net.send")
+    with pytest.raises(InjectedNetworkFault):
+        fi.check("net.send")
+
+
+def test_probabilistic_rule_is_seeded():
+    def fire_pattern(seed):
+        fi = FaultInjector("net.drop%0.5", seed=seed)
+        pattern = []
+        for _ in range(32):
+            try:
+                fi.check("net.send")
+                pattern.append(False)
+            except InjectedNetworkFault:
+                pattern.append(True)
+        return pattern
+
+    assert fire_pattern(7) == fire_pattern(7)
+    assert fire_pattern(7) != fire_pattern(8)
+    assert any(fire_pattern(7))
+
+
+def test_injector_install_reset():
+    inj = resilience.install("snapshot.fail@1", seed=5)
+    assert resilience.get_injector() is inj
+    resilience.reset()
+    assert not resilience.get_injector().active
+
+
+# -- frame-size bounds (hostile/corrupt length headers) --------------------
+
+def test_oversize_frame_header_reads_as_dead_peer():
+    a, b = socket.socketpair()
+    try:
+        # A corrupt/hostile 8-byte header claiming a 1 TiB payload
+        # must NOT drive _recv_exact into an unbounded read loop.
+        a.sendall(_HEADER.pack(1 << 40, 0))
+        assert recv_message(b) is None
+        assert resilience.stats.get("net.oversize") == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_decompression_bomb_bounded():
+    a, b = socket.socketpair()
+    try:
+        blob = gzip.compress(b"\x00" * 300000, compresslevel=1)
+        a.sendall(_HEADER.pack(len(blob), 1) + blob)  # flag 1 = gzip
+        assert recv_message(b, max_message=1000) is None
+        assert resilience.stats.get("net.oversize") == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_truncated_gzip_frame_reads_as_dead_peer():
+    """A MAC-valid frame whose gzip stream is truncated (valid
+    prefix, no terminator) must NOT hand partial plaintext to the
+    unpickler."""
+    a, b = socket.socketpair()
+    try:
+        blob = gzip.compress(b"\x00" * 100000, compresslevel=1)[:-8]
+        a.sendall(_HEADER.pack(len(blob), 1) + blob)
+        assert recv_message(b) is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_cap_configurable_and_legit_traffic_passes():
+    a, b = socket.socketpair()
+    try:
+        send_message(a, {"cmd": "x"})
+        assert recv_message(b)["cmd"] == "x"
+        send_message(a, {"cmd": "y"})
+        assert recv_message(b, max_frame=4) is None  # tiny cap trips
+    finally:
+        a.close()
+        b.close()
+
+
+# -- connect timeout hygiene -----------------------------------------------
+
+def test_connect_clears_connect_timeout():
+    """The connect timeout must not stay armed on the socket: a
+    worker blocking in recv for a long job would hit socket.timeout
+    and be misread as a dead peer."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    try:
+        addr = "127.0.0.1:%d" % srv.getsockname()[1]
+        sock = connect(addr, timeout=5.0)
+        assert sock.gettimeout() is None  # blocking post-connect
+        sock.close()
+        sock = connect(addr, timeout=5.0, io_timeout=1.5)
+        assert sock.gettimeout() == 1.5
+        sock.close()
+    finally:
+        srv.close()
+
+
+# -- ledger workflow (shared by protocol-level chaos tests) ----------------
+
+class LedgerWorkflow(Workflow):
+    """A master/worker workflow whose job ledger proves exactly-once
+    accounting: every job id must be applied exactly once, across
+    worker deaths, requeues, and coordinator crash-resume.  Pickled
+    state requeues outstanding jobs (the loader contract,
+    loader/base.py __getstate__)."""
+
+    def __init__(self, launcher, total_jobs=6, **kwargs):
+        super(LedgerWorkflow, self).__init__(launcher, **kwargs)
+        self.body = TrivialUnit(self)
+        self.body.link_from(self.start_point)
+        self.end_point.link_from(self.body)
+        self.total_jobs = total_jobs
+        self.next_job = 1
+        self.done = {}          # job id -> apply count (must be 1)
+        self.outstanding = {}   # slave id -> [job ids in flight]
+        self.requeued = []      # jobs waiting to be re-served
+        self.requeue_log = []   # every requeue event, in order
+        self.jobs_run = 0       # worker side
+        self.snap = None        # master-side snapshotter (optional)
+
+    # master side
+    def generate_data_for_slave(self, slave=None):
+        if self.requeued:
+            n = self.requeued.pop(0)
+        elif self.next_job <= self.total_jobs:
+            n = self.next_job
+            self.next_job += 1
+        else:
+            return None
+        self.outstanding.setdefault(slave, []).append(n)
+        return {"n": n}
+
+    def apply_data_from_slave(self, data, slave=None):
+        n = data["echo"]
+        lst = self.outstanding.get(slave, [])
+        if n not in lst:
+            return  # late/unknown update: already requeued elsewhere
+        lst.remove(n)
+        self.done[n] = self.done.get(n, 0) + 1
+        if self.snap is not None:
+            self.snap.export()
+
+    def drop_slave(self, slave=None):
+        for n in self.outstanding.pop(slave, []):
+            self.requeued.append(n)
+            self.requeue_log.append(n)
+
+    def should_stop_serving(self):
+        return (len(self.done) >= self.total_jobs and
+                not self.requeued and
+                not any(self.outstanding.values()))
+
+    # worker side
+    def do_job(self, data, update, callback):
+        self.jobs_run += 1
+        callback({"echo": data["n"]})
+
+    # crash-resume contract: in-flight jobs ride the snapshot as
+    # requeued work, exactly like the loader's failed-minibatch queue.
+    def __getstate__(self):
+        state = super(LedgerWorkflow, self).__getstate__()
+        inflight = [n for lst in self.outstanding.values()
+                    for n in lst]
+        state["requeued"] = list(self.requeued) + inflight
+        state["outstanding"] = {}
+        return state
+
+
+def _start_client(addr, injector=None, attempts=100, delay=0.02,
+                  **kwargs):
+    slave = LedgerWorkflow(Launcher())
+    client = Client(addr, slave, injector=injector,
+                    reconnect_attempts=attempts,
+                    reconnect_delay=delay, **kwargs)
+    thread = threading.Thread(target=client.run, daemon=True)
+    thread.start()
+    return client, thread, slave
+
+
+# -- atomic snapshot writes ------------------------------------------------
+
+def _ledger_with_snapshotter(tmp_path, **snap_kwargs):
+    wf = LedgerWorkflow(Launcher())
+    snap_kwargs.setdefault("directory", str(tmp_path))
+    snap_kwargs.setdefault("prefix", "ledger")
+    snap_kwargs.setdefault("time_interval", 0.0)
+    snap_kwargs.setdefault("compression", "")
+    snap = SnapshotterToFile(wf, **snap_kwargs)
+    snap.initialize()
+    return wf, snap
+
+
+def test_snapshot_write_is_atomic_under_crash(tmp_path, monkeypatch):
+    """A crash mid-pickle must never clobber the previous good
+    snapshot at the same path."""
+    wf, snap = _ledger_with_snapshotter(tmp_path)
+    wf.done[1] = 1
+    snap.export()
+    path = snap.destination
+    with open(path, "rb") as fin:
+        good = fin.read()
+
+    def explode(*a, **k):
+        raise OSError("disk died mid-pickle")
+
+    monkeypatch.setattr("veles_tpu.snapshotter.pickle.dump", explode)
+    snap.retry_policy = RetryPolicy(max_attempts=1, base_delay=0.0,
+                                    jitter=0.0)
+    with pytest.raises(OSError):
+        snap.export()
+    with open(path, "rb") as fin:
+        assert fin.read() == good  # previous snapshot intact
+    assert not [f for f in os.listdir(str(tmp_path))
+                if f.endswith(".part")]  # temp cleaned up
+    resumed = pickle.loads(good)
+    assert resumed.done == {1: 1}
+
+
+def test_snapshot_write_retries_injected_fault(tmp_path):
+    injector = FaultInjector("snapshot.fail@1")
+    wf, snap = _ledger_with_snapshotter(tmp_path, injector=injector)
+    snap.export()  # first attempt faults, retry succeeds
+    assert snap.destination and os.path.isfile(snap.destination)
+    assert resilience.stats.get("snapshot.retry") == 1
+    assert resilience.stats.get("snapshot.write") == 1
+    assert injector.fired[0][0] == "snapshot.fail"
+
+
+def test_current_link_is_atomic_and_latest_snapshot_finds_it(tmp_path):
+    wf, snap = _ledger_with_snapshotter(tmp_path)
+    snap.export()
+    link = os.path.join(str(tmp_path), "ledger_current.lnk")
+    assert os.path.isfile(link)
+    assert latest_snapshot(str(tmp_path)) == snap.destination
+    assert latest_snapshot(str(tmp_path), "ledger") == snap.destination
+    assert latest_snapshot(str(tmp_path), "other") is None
+    # Dangling pointer (operator deleted the snapshot) is skipped.
+    os.unlink(snap.destination)
+    assert latest_snapshot(str(tmp_path)) is None
+    assert latest_snapshot(str(tmp_path / "missing")) is None
+
+
+# -- crash-resume hardening ------------------------------------------------
+
+def test_default_reconnect_policy_survives_master_restart():
+    """The DEFAULT worker retry budget must outlive a realistic
+    coordinator restart (python + jax import + snapshot unpickle ≈
+    a minute) — the crash-resume workflow promises workers need no
+    operator action."""
+    wf = LedgerWorkflow(Launcher())
+    client = Client("127.0.0.1:1", wf)
+    assert client.retry_policy.max_attempts >= 20
+    total = sum(client.retry_policy.delays())
+    assert total > 120.0  # minutes of dialing, not seconds
+
+
+def test_launcher_run_raises_on_crashed_server():
+    """An injected coordinator crash must NOT look like a clean
+    exit: the CLI would write results from a half-trained workflow
+    and exit 0, so a restart-on-failure supervisor never fires."""
+    launcher = Launcher()
+    wf = LedgerWorkflow(launcher)
+
+    class DeadServer(object):
+        crashed = True
+
+        def wait(self, timeout=None):
+            pass
+
+        def stop(self):
+            pass
+
+    launcher.server = DeadServer()
+    with pytest.raises(MasterCrash):
+        launcher.run()
+
+
+class OtherWorkflow(Workflow):
+    """An unrelated training sharing the snapshot directory."""
+
+    def __init__(self, launcher, **kwargs):
+        super(OtherWorkflow, self).__init__(launcher, **kwargs)
+        self.body = TrivialUnit(self)
+        self.body.link_from(self.start_point)
+        self.end_point.link_from(self.body)
+
+
+def test_resume_latest_skips_other_workflow_families(tmp_path):
+    """--auto-resume in a SHARED snapshot directory must not adopt
+    another training's (newer) snapshot: candidates not matching the
+    expected workflow class are skipped, newest-first."""
+    mine, my_snap = _ledger_with_snapshotter(tmp_path, prefix="mine")
+    mine.done[4] = 1
+    my_snap.export()
+    time.sleep(0.05)  # the foreign family's pointer is NEWER
+    other = OtherWorkflow(Launcher())
+    other_snap = SnapshotterToFile(other, directory=str(tmp_path),
+                                   prefix="other",
+                                   time_interval=0.0,
+                                   compression="")
+    other_snap.initialize()
+    other_snap.export()
+    # Unguarded, newest wins — the hijack the guard exists for.
+    assert isinstance(Launcher().resume_latest(
+        directory=str(tmp_path)), OtherWorkflow)
+    # Guarded, the newer foreign snapshot is skipped and the older
+    # matching family is adopted with its ledger intact.
+    resumed = Launcher().resume_latest(directory=str(tmp_path),
+                                       expect_class=LedgerWorkflow)
+    assert type(resumed) is LedgerWorkflow
+    assert resumed.done == {4: 1}
+    # A directory holding ONLY foreign families resumes nothing.
+    assert Launcher().resume_latest(
+        directory=str(tmp_path), prefix="other",
+        expect_class=LedgerWorkflow) is None
+
+
+# -- legacy flag subsumption -----------------------------------------------
+
+def test_death_probability_folds_into_injector():
+    wf = LedgerWorkflow(Launcher())
+    client = Client("127.0.0.1:1", wf, death_probability=0.25)
+    assert client.injector is not None and client.injector.active
+    rule = client.injector._rules[0]
+    assert rule.fault == "worker.kill"
+    assert rule.probability == 0.25
+
+
+# -- watchdog blacklist -> requeue (driven by the FaultInjector) -----------
+
+def test_watchdog_blacklists_hung_worker_and_requeues_exactly_once():
+    """A worker hung mid-job (worker.hang chaos) trips the adaptive
+    job timeout: the watchdog blacklists it, its in-flight job is
+    re-dispatched to a healthy worker EXACTLY once, and the run
+    completes with a clean ledger."""
+    master = LedgerWorkflow(Launcher(), total_jobs=3)
+    server = Server(":0", master, job_timeout=0.4,
+                    watchdog_interval=0.05)
+    addr = "127.0.0.1:%d" % server.port
+    hang_injector = FaultInjector("worker.hang@job:1")
+    client_a, thread_a, _ = _start_client(addr, injector=hang_injector,
+                                          attempts=0)
+    deadline = time.time() + 10
+    while resilience.stats.get("server.blacklist") < 1 and \
+            time.time() < deadline:
+        time.sleep(0.02)
+    assert resilience.stats.get("server.blacklist") == 1
+    client_b, thread_b, _ = _start_client(addr)
+    server.wait(timeout=20)
+    assert not server.is_running
+    client_a.stop()
+    thread_a.join(timeout=5)
+    thread_b.join(timeout=5)
+    # Exactly-once: the hung worker's job was requeued once and only
+    # its healthy re-execution landed in the ledger.
+    assert master.done == {1: 1, 2: 1, 3: 1}
+    assert master.requeue_log == [1]
+    assert resilience.stats.get("server.requeue") >= 1
+    assert resilience.stats.get("client.hang") == 1
+    assert hang_injector.fired == [("worker.hang", "job", 1)]
+
+
+# -- network chaos: dropped frames recover through reconnect ---------------
+
+def test_net_drop_recovers_and_ledger_stays_exact():
+    master = LedgerWorkflow(Launcher(), total_jobs=4)
+    server = Server(":0", master)
+    addr = "127.0.0.1:%d" % server.port
+    injector = FaultInjector("net.drop@job:2")
+    client, thread, slave = _start_client(addr, injector=injector)
+    server.wait(timeout=20)
+    thread.join(timeout=5)
+    assert not server.is_running
+    assert master.done == {n: 1 for n in range(1, 5)}
+    assert [f[0] for f in injector.fired] == ["net.drop"]
+    assert resilience.stats.get("client.reconnect") >= 1
+
+
+# -- the acceptance scenario: seeded chaos plan, worker kill mid-job, ------
+# -- coordinator crash mid-run, crash-resume, exactly-once ledger ----------
+
+CHAOS_PLAN = "worker.kill@job:3,master.crash@job:7,seed:42"
+
+
+def _run_chaos_scenario(snapshot_dir):
+    """One full run of the acceptance chaos plan.  Returns the
+    resumed master plus both injectors' fired logs."""
+    master = LedgerWorkflow(Launcher(), total_jobs=12)
+    snap = SnapshotterToFile(master, directory=snapshot_dir,
+                             prefix="chaos", time_interval=0.0,
+                             compression="")
+    snap.initialize()
+    master.snap = snap
+    # The SAME plan is installed on both sides (per-process
+    # semantics): each process's rules fire off its own counters.
+    master_injector = FaultInjector(CHAOS_PLAN)
+    worker_injector = FaultInjector(CHAOS_PLAN)
+    server = Server(":0", master, injector=master_injector)
+    port = server.port
+    addr = "127.0.0.1:%d" % port
+    client, thread, _ = _start_client(addr, injector=worker_injector)
+    # Phase 1: the worker dies at its 3rd job (rejoins as a fresh
+    # worker), then the coordinator crashes at its 7th serve.
+    server.wait(timeout=30)
+    assert server.crashed
+    assert resilience.stats.get("client.death") == 1
+    # Phase 2: coordinator crash-resume — a restarted master adopts
+    # the newest atomic snapshot on the SAME address; the worker's
+    # retry policy is still dialing.
+    relauncher = Launcher()
+    resumed = relauncher.resume_latest(directory=snapshot_dir,
+                                       prefix="chaos")
+    assert resumed is not None
+    assert resilience.stats.get("master.resume") == 1
+    snap2 = resumed.snap
+    assert snap2 is not None  # snapshotter rode the snapshot
+    server2 = Server(("127.0.0.1", port), resumed)
+    server2.wait(timeout=30)
+    thread.join(timeout=10)
+    assert not server2.is_running and not server2.crashed
+    client.stop()
+    return resumed, master, master_injector, worker_injector
+
+
+def test_chaos_plan_worker_kill_master_crash_resume_exactly_once(
+        tmp_path):
+    resumed, master, m_inj, w_inj = _run_chaos_scenario(
+        str(tmp_path / "run"))
+    # Every minibatch accounted for exactly once across BOTH lives of
+    # the coordinator: jobs applied before the crash persist in the
+    # snapshot, in-flight ones were requeued at pickle time, and
+    # nothing was double-counted.
+    assert resumed.done == {n: 1 for n in range(1, 13)}
+    assert max(resumed.done.values()) == 1
+    # The failure schedule is the planned one.
+    assert m_inj.fired == [("master.crash", "job", 7)]
+    assert w_inj.fired == [("worker.kill", "job", 3)]
+    # The first life's ledger stopped where the crash hit.
+    assert len(master.done) < 12
+
+
+def test_chaos_plan_is_reproducible_across_runs(tmp_path):
+    """The same seeded plan reproduces the identical
+    failure/recovery sequence twice — the determinism contract."""
+    r1, _, m1, w1 = _run_chaos_scenario(str(tmp_path / "a"))
+    resilience.reset()
+    prng.reset()
+    r2, _, m2, w2 = _run_chaos_scenario(str(tmp_path / "b"))
+    assert m1.fired == m2.fired
+    assert w1.fired == w2.fired
+    assert r1.done == r2.done
+
+
+# -- master crash point also fires on updates ------------------------------
+
+def test_master_crash_on_update_counter():
+    master = LedgerWorkflow(Launcher(), total_jobs=8)
+    injector = FaultInjector("master.crash@update:2")
+    server = Server(":0", master, injector=injector)
+    client, thread, _ = _start_client(
+        "127.0.0.1:%d" % server.port, attempts=0)
+    server.wait(timeout=20)
+    assert server.crashed
+    assert injector.fired == [("master.crash", "update", 2)]
+    client.stop()
+    thread.join(timeout=5)
+
+
+# -- stats surfacing -------------------------------------------------------
+
+def test_resilience_stats_in_launcher_payload_and_web_status():
+    resilience.stats.incr("server.blacklist")
+    resilience.stats.incr("client.reconnect", 3)
+    launcher = Launcher()
+    payload = launcher.status_payload("m1")
+    assert payload["resilience"] == {"server.blacklist": 1,
+                                     "client.reconnect": 3}
+    from veles_tpu.web_status import WebStatusServer
+    status = WebStatusServer(port=0)
+    try:
+        status.update({"id": "m1", "workflow": "W", "mode": "master",
+                       "resilience": payload["resilience"]})
+        page = status.render_page()
+        assert "resilience" in page
+        assert "server.blacklist" in page
+    finally:
+        status._httpd.server_close()
+
+
+def test_print_stats_reports_resilience_events(caplog):
+    import logging
+    resilience.stats.incr("server.drop", 2)
+    wf = LedgerWorkflow(Launcher())
+    with caplog.at_level(logging.INFO):
+        wf.print_stats()
+    assert any("server.drop=2" in m for m in caplog.messages)

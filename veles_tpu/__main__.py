@@ -160,6 +160,15 @@ class Main(Logger, CommandLineBase):
                     str(a.slave_death_probability)]
         if a.measure_power:
             out.append("--measure-power")
+        if a.reconnect_attempts is not None:
+            out += ["--reconnect-attempts", str(a.reconnect_attempts)]
+        if a.reconnect_delay is not None:
+            out += ["--reconnect-delay", str(a.reconnect_delay)]
+        if a.chaos:
+            # Workers install the SAME plan: each process's rules
+            # fire off its own logical counters, so the combined
+            # failure schedule stays reproducible.
+            out += ["--chaos", a.chaos]
         if a.train_ratio is not None:
             out += ["--train-ratio", str(a.train_ratio)]
         if a.shuffle_limit is not None:
@@ -168,6 +177,8 @@ class Main(Logger, CommandLineBase):
 
     def _launcher_kwargs(self):
         kw = {}
+        if self.args.chaos:
+            kw["chaos"] = self.args.chaos
         if self.args.listen_address:
             kw["listen_address"] = self.args.listen_address
             if self.args.nodes:
@@ -182,8 +193,18 @@ class Main(Logger, CommandLineBase):
             if self.args.slave_death_probability:
                 slave_kwargs["death_probability"] = \
                     self.args.slave_death_probability
+                # A CLI worker really dies (its supervisor/respawn
+                # hook restarts the process); in-process clients
+                # default to abort-and-rejoin instead.
+                slave_kwargs["death_exits"] = True
             if self.args.measure_power:
                 slave_kwargs["measure_power"] = True
+            if self.args.reconnect_attempts is not None:
+                slave_kwargs["reconnect_attempts"] = \
+                    self.args.reconnect_attempts
+            if self.args.reconnect_delay is not None:
+                slave_kwargs["reconnect_delay"] = \
+                    self.args.reconnect_delay
             if slave_kwargs:
                 kw["slave_kwargs"] = slave_kwargs
         if self.args.jax_coordinator or self.args.jax_num_processes \
@@ -246,6 +267,14 @@ class Main(Logger, CommandLineBase):
             self.launcher.add_ref(self.workflow)
             self.info("resumed snapshot %s (%s)", self.args.snapshot,
                       type(self.workflow).__name__)
+        elif self.args.auto_resume and self.launcher.resume_latest(
+                expect_class=WorkflowClass) is not None:
+            # Coordinator crash-resume: a restarted master picks up
+            # the newest *_current.lnk snapshot; in-flight jobs were
+            # requeued at pickle time, so the ledger resumes without
+            # losing or double-counting a minibatch.
+            self.workflow = self.launcher.workflow
+            self._snapshot_loaded = True
         else:
             self.workflow = WorkflowClass(self.launcher, **kwargs)
         if self.args.max_epochs:

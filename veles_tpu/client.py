@@ -9,12 +9,14 @@ injection ``:302-307,438-442``, bounded reconnect attempts
 """
 
 import os
-import random
 import time
 
+from . import resilience
 from .logger import Logger
 from .network_common import (Channel, connect, machine_id,
                              normalize_secret)
+from .resilience import (HandshakeRejected, RetryPolicy, WorkerHang,
+                         WorkerKilled)
 
 
 def init_parser(parser):
@@ -32,6 +34,15 @@ def init_parser(parser):
         "--measure-power", action="store_true",
         help="benchmark this worker's computing power and report it "
              "to the coordinator (periodic re-measure included)")
+    parser.add_argument(
+        "--reconnect-attempts", type=int, default=None, metavar="N",
+        help="consecutive failed reconnects before the worker gives "
+             "up (default 20 ≈ 6-7 minutes of dialing, enough to "
+             "survive a coordinator crash-resume restart)")
+    parser.add_argument(
+        "--reconnect-delay", type=float, default=None, metavar="SEC",
+        help="base reconnect backoff in seconds (default 0.2; grows "
+             "exponentially with seeded jitter, capped at 30s)")
 
 
 def measure_computing_power(repeats=2, n=1024):
@@ -60,8 +71,41 @@ class Client(Logger):
         self.address = address
         self.workflow = workflow
         self.death_probability = kwargs.get("death_probability", 0.0)
-        self.reconnect_attempts = kwargs.get("reconnect_attempts", 5)
+        #: 20 consecutive failed attempts ≈ 6-7 minutes of dialing
+        #: (exponential, capped at 30s): the crash-resume contract
+        #: says workers outlive a coordinator restart (python + jax
+        #: import + snapshot unpickle can take a minute), so the
+        #: DEFAULT must cover that — the old default of 5 gave up
+        #: after ~6 seconds.
+        self.reconnect_attempts = kwargs.get("reconnect_attempts", 20)
         self.reconnect_delay = kwargs.get("reconnect_delay", 0.2)
+        #: Reconnect schedule: exponential backoff + seeded jitter
+        #: (replaces the old hand-rolled linear sleep loop).
+        self.retry_policy = kwargs.get("retry_policy") or RetryPolicy(
+            max_attempts=self.reconnect_attempts,
+            base_delay=self.reconnect_delay)
+        #: Fault injector (resilience.FaultInjector).  The legacy
+        #: ``--slave-death-probability`` flag is folded in as a
+        #: ``worker.kill%p`` rule — one chaos engine, one code path.
+        self.injector = kwargs.get("injector")
+        if self.death_probability:
+            if self.injector is None:
+                # Per-PROCESS seed: the legacy flag's random.random()
+                # was independent per worker; a shared constant seed
+                # would make the whole fleet draw identical kill
+                # verdicts and die in lock-step.
+                import uuid
+                self.injector = resilience.FaultInjector(
+                    seed=(uuid.getnode() * 1000003 + os.getpid())
+                    & 0xFFFFFFFF)
+            self.injector.add_rule(
+                "worker.kill%%%g" % self.death_probability)
+        #: True makes an injected worker.kill really exit the process
+        #: (CLI workers under a supervisor); False (default) aborts
+        #: the session and reconnects with a fresh id — process death
+        #: + respawn collapsed into one object, which is what the
+        #: in-process chaos tests need.
+        self.death_exits = kwargs.get("death_exits", False)
         self.poll_delay = kwargs.get("poll_delay", 0.05)
         self.power = kwargs.get("power") or 1.0
         self.measure_power = kwargs.get("measure_power", False)
@@ -87,34 +131,80 @@ class Client(Logger):
     def stop(self):
         self._stop = True
 
+    def _injector_(self):
+        return resilience.effective(self.injector)
+
     def run(self):
         """Blocking job loop with bounded reconnects
-        (reference FSM: connect → handshake → job cycle)."""
+        (reference FSM: connect → handshake → job cycle).  The
+        reconnect schedule is the shared :class:`RetryPolicy`
+        (exponential backoff + seeded jitter); the attempt counter
+        resets on every successful handshake, so a long-lived worker
+        survives any number of transient master outages."""
         attempts = 0
-        while not self._stop and attempts <= self.reconnect_attempts:
+        policy = self.retry_policy
+        while not self._stop:
+            chan = None
             try:
+                self._injector_().check("net.connect")
                 sock = connect(self.address, timeout=30.0)
-            except OSError:
-                attempts += 1
-                time.sleep(self.reconnect_delay * attempts)
-                continue
-            chan = Channel(sock, self._secret)
-            try:
-                if not self._handshake(chan):
-                    attempts += 1
-                    time.sleep(self.reconnect_delay * attempts)
-                    continue
-                attempts = 0
-                cycle = (self._job_cycle_async if self.async_mode
-                         else self._job_cycle)
-                if cycle(chan):
-                    return  # orderly bye
-            except (OSError, ConnectionError):
-                pass
+                chan = Channel(sock, self._secret,
+                               injector=self.injector)
+                if self._handshake(chan):
+                    attempts = 0
+                    cycle = (self._job_cycle_async if self.async_mode
+                             else self._job_cycle)
+                    if cycle(chan):
+                        return  # orderly bye
+            except HandshakeRejected as e:
+                self.warning("%s — giving up (the coordinator is "
+                             "alive; fix the mismatch and restart "
+                             "this worker)", e)
+                return
+            except WorkerKilled:
+                # Chaos (reference: client.py:438-442).  The session
+                # dies abruptly — no bye — and either the process
+                # really exits (CLI under a supervisor) or the loop
+                # reconnects as a fresh worker, modelling the respawn.
+                self.warning("simulating slave death")
+                resilience.stats.incr("client.death")
+                if self.death_exits:
+                    os._exit(1)
+                self.id = None
+            except WorkerHang as e:
+                # Chaos: stall with the connection open — the
+                # coordinator's adaptive-timeout watchdog must
+                # blacklist us and requeue our job.
+                self.warning("simulating worker hang")
+                resilience.stats.incr("client.hang")
+                self._sleep_interruptible(e.seconds)
+            except (OSError, ConnectionError) as e:
+                # Connection-level OR job-local I/O failure: the
+                # session is dead either way, but it must be VISIBLE —
+                # a persistent local fault (dataset file deleted)
+                # would otherwise loop through reconnects with zero
+                # diagnostics.
+                self.warning("worker session aborted: %r", e)
             finally:
-                chan.close()
+                if chan is not None:
+                    chan.close()
+            if self._stop:
+                return
             attempts += 1
-            time.sleep(self.reconnect_delay * attempts)
+            if attempts > policy.max_attempts:
+                self.warning("giving up after %d reconnect attempts",
+                             policy.max_attempts)
+                return
+            resilience.stats.incr("client.reconnect")
+            self._sleep_interruptible(policy.delay(attempts - 1))
+
+    def _sleep_interruptible(self, seconds):
+        """Sleeps in small increments so :meth:`stop` stays
+        responsive — backoff sleeps reach 30 s each, and a shutdown
+        must not wait one out."""
+        deadline = time.time() + seconds
+        while not self._stop and time.time() < deadline:
+            time.sleep(0.05)
 
     # -- phases ------------------------------------------------------------
 
@@ -163,10 +253,9 @@ class Client(Logger):
                 continue
             if cmd != "job":
                 continue
-            if self.death_probability and \
-                    random.random() < self.death_probability:
-                self.warning("simulating slave death")
-                os._exit(1)
+            inj = self._injector_()
+            inj.tick("job")
+            inj.check("worker.job")
             # Pipeline: request N+1 BEFORE computing N.
             chan.send({"cmd": "job_request"})
             update = self._run_job(msg["data"])
@@ -198,8 +287,10 @@ class Client(Logger):
                 self.workflow.checksum)
             return False
         if reply.get("cmd") != "handshake_ack":
-            self.warning("handshake rejected: %s", reply)
-            return False
+            # The server is alive and said no (checksum mismatch
+            # error frame, ...) — a PERMANENT condition; retrying
+            # the reconnect schedule against it wastes minutes.
+            raise HandshakeRejected("handshake rejected: %r" % reply)
         self.id = reply["id"]
         # Session nonce: every later frame is MAC-bound to it (see
         # network_common.Channel).  A missing nonce means a peer that
@@ -207,9 +298,9 @@ class Client(Logger):
         # silently continuing on static keying.
         nonce = reply.get("nonce")
         if not nonce:
-            self.warning("handshake_ack carried no session nonce — "
-                         "refusing the session")
-            return False
+            raise HandshakeRejected(
+                "handshake_ack carried no session nonce — refusing "
+                "the session (peer cannot provide replay protection)")
         chan.rekey(nonce)
         initial = reply.get("initial")
         if initial:
@@ -232,11 +323,9 @@ class Client(Logger):
                 continue
             if cmd != "job":
                 continue
-            if self.death_probability and \
-                    random.random() < self.death_probability:
-                # Chaos testing (reference: client.py:438-442).
-                self.warning("simulating slave death")
-                os._exit(1)
+            inj = self._injector_()
+            inj.tick("job")
+            inj.check("worker.job")
             update = self._run_job(msg["data"])
             chan.send({"cmd": "update", "data": update})
             ack = chan.recv()

@@ -72,6 +72,12 @@ class CommandLineBase(object):
             help="resume from a snapshot file (or a _current.lnk "
                  "pointer)")
         parser.add_argument(
+            "--chaos", default="", metavar="PLAN",
+            help="deterministic fault-injection plan, e.g. "
+                 "'net.drop@job:7,worker.kill@job:12,seed:42' — "
+                 "replaces --slave-death-probability with a seeded, "
+                 "replayable failure schedule (docs/resilience.md)")
+        parser.add_argument(
             "-l", "--listen-address", default="", metavar="HOST:PORT",
             help="run as the distributed coordinator (master), "
                  "listening on HOST:PORT")

@@ -88,7 +88,6 @@ class GraphicsClient(Logger):
         os.makedirs(self.output_dir, exist_ok=True)
         registry = _plotter_registry()
         self._sock = connect(self.address, timeout=30.0)
-        self._sock.settimeout(None)
         self.info("subscribed to %s; plots -> %s", self.address,
                   self.output_dir)
         while True:

@@ -20,6 +20,7 @@ import os
 import threading
 import time
 
+from . import resilience
 from .config import root, get as config_get
 from .logger import Logger
 
@@ -43,6 +44,16 @@ class Launcher(Logger):
         if self.master_address and self._mode == "standalone":
             self._mode = "slave"
         self.slave_kwargs = kwargs.get("slave_kwargs", {})
+        # Deterministic chaos (--chaos "net.drop@job:7,seed:42"):
+        # installing the plan process-wide reaches every Channel,
+        # Server, Client, and Snapshotter without explicit wiring —
+        # the same plan + seed reproduces the same failure sequence.
+        chaos = kwargs.get("chaos")
+        if chaos:
+            self.injector = resilience.install(chaos)
+            self.info("chaos plan installed: %s", chaos)
+        else:
+            self.injector = kwargs.get("injector")
         self.server = None
         self.client = None
         self._running = threading.Event()
@@ -114,6 +125,58 @@ class Launcher(Logger):
         if self.workflow is workflow:
             self.workflow = None
 
+    # -- coordinator crash-resume ------------------------------------------
+
+    def resume_latest(self, directory=None, prefix=None,
+                      expect_class=None):
+        """Coordinator crash-resume: loads the newest snapshot named
+        by a ``*_current.lnk`` pointer in the snapshot directory,
+        adopts it as this launcher's workflow, and returns it — or
+        returns None when there is nothing to resume (fresh start).
+
+        ``expect_class`` guards shared snapshot directories: only a
+        snapshot holding an instance of that workflow class is
+        adopted (newest first); snapshots of OTHER trainings are
+        skipped with a warning instead of silently hijacking the run.
+        (Skipping still costs a full unpickle of the foreign
+        snapshot — give concurrent trainings distinct directories or
+        prefixes when snapshots are large.)
+
+        Because snapshot writes are atomic (temp + ``os.replace``)
+        and the workflow's pickled state requeues every in-flight
+        job (loader ``__getstate__``), a master restarted through
+        this path re-serves exactly the minibatches whose updates
+        had not been applied at snapshot time: nothing is lost,
+        nothing double-counted.  Workers reconnect on their own —
+        the client retry policy keeps dialing while the master is
+        down."""
+        directory = directory or config_get(
+            root.common.dirs.snapshots, "snapshots")
+        from .snapshotter import SnapshotterToFile
+        for path in resilience.iter_snapshots(directory, prefix):
+            try:
+                workflow = SnapshotterToFile.import_(path)
+            except Exception as e:
+                # An unloadable snapshot (older code revision, a
+                # half-restored file) must not abort the recovery
+                # path — fall through to the next candidate.
+                self.warning("crash-resume: cannot load %s (%s) — "
+                             "trying the next snapshot", path, e)
+                continue
+            if expect_class is not None and \
+                    not isinstance(workflow, expect_class):
+                self.warning(
+                    "crash-resume: skipping %s — it holds a %s, "
+                    "not the %s this invocation trains", path,
+                    type(workflow).__name__, expect_class.__name__)
+                continue
+            self.add_ref(workflow)
+            resilience.stats.incr("master.resume")
+            self.info("crash-resume: adopted snapshot %s (%s)", path,
+                      type(workflow).__name__)
+            return workflow
+        return None
+
     # -- lifecycle ---------------------------------------------------------
 
     def initialize(self, **kwargs):
@@ -156,11 +219,14 @@ class Launcher(Logger):
         if self.is_master and self.listen_address:
             from .server import Server
             self.server = Server(self.listen_address, self.workflow,
-                                 on_stopped=self.on_workflow_finished)
+                                 on_stopped=self.on_workflow_finished,
+                                 injector=self.injector)
         elif self.is_slave and self.master_address:
             from .client import Client
+            slave_kwargs = dict(self.slave_kwargs)
+            slave_kwargs.setdefault("injector", self.injector)
             self.client = Client(self.master_address, self.workflow,
-                                 **self.slave_kwargs)
+                                 **slave_kwargs)
         if config_get(root.common.graphics.enabled, False):
             from .graphics_server import GraphicsServer
             self.graphics_server = GraphicsServer.launch()
@@ -242,6 +308,19 @@ class Launcher(Logger):
         try:
             if self.server is not None:
                 self.server.wait()
+                if self.server.crashed:
+                    # A crashed coordinator must NOT look like a
+                    # clean exit: the CLI would write a results file
+                    # from the half-trained workflow and exit 0, and
+                    # a restart-on-failure supervisor (the documented
+                    # crash-resume recovery path) would never fire.
+                    raise resilience.MasterCrash("master.crash")
+                if getattr(self.server, "failure", None) is not None:
+                    # Same contract for a server stopped by a
+                    # master-side error (failed update apply,
+                    # exhausted snapshot retries): nonzero exit, no
+                    # results file.
+                    raise self.server.failure
             elif self.client is not None:
                 self.client.run()
             else:
@@ -319,6 +398,11 @@ class Launcher(Logger):
                       "power": desc.power,
                       "blacklisted": desc.blacklisted}
                 for sid, desc in self.server.slaves.items()}
+        # Resilience events (retries, drops, blacklists, crashes,
+        # resumes): operators see degradation, not just survive it.
+        events = resilience.stats.snapshot()
+        if events:
+            payload["resilience"] = events
         # Dashboard depth (reference: web_status.py:113-243 shows the
         # Graphviz workflow graph and plot links): the DOT text rides
         # the first beat and a ~per-minute refresh (the dashboard

@@ -21,6 +21,7 @@ import pickle
 import socket
 import struct
 import uuid
+import zlib
 
 _HEADER = struct.Struct(">QB")  # payload length, flags
 _FLAG_GZIP = 1
@@ -29,6 +30,15 @@ _DIGEST_SIZE = hashlib.sha256().digest_size
 #: Payloads above this size are compressed (control messages are tiny;
 #: index arrays for big blocks may not be).
 COMPRESS_THRESHOLD = 1 << 16
+
+#: Frame-size bounds.  The 8-byte length header is network-supplied:
+#: without a cap a corrupt/hostile header drives ``_recv_exact`` into
+#: an unbounded allocation loop, and a tiny gzip frame can expand into
+#: gigabytes (decompression bomb).  Oversize either way is treated as
+#: a dead peer.  Control traffic is small; raise these only for
+#: genuinely huge index blocks.
+MAX_FRAME_SIZE = 1 << 30
+MAX_MESSAGE_SIZE = 1 << 30
 
 
 def parse_address(address, default_port=5050):
@@ -72,8 +82,25 @@ def send_message(sock, obj, secret=None, nonce=b"", seq=None):
     """Frames and sends one pickled message (blocking).  With
     ``secret``, an HMAC-SHA256 over nonce+seq+flags+body is prepended
     so the peer can authenticate the frame BEFORE unpickling (pickle
-    from an unauthenticated peer is arbitrary code execution)."""
+    from an unauthenticated peer is arbitrary code execution).
+
+    Frames beyond :data:`MAX_FRAME_SIZE` fail HERE, loudly: the
+    receiver would silently drop the peer (its cap guards against
+    hostile headers), and 'worker reconnects forever with a
+    misleading handshake warning' is a far worse diagnostic than an
+    exception naming the knob."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    # Compression only shrinks the wire frame, so bounding the raw
+    # pickle against BOTH receiver caps here (minus MAC headroom)
+    # guarantees the peer accepts the frame.
+    cap = min(MAX_FRAME_SIZE, MAX_MESSAGE_SIZE) - 4096
+    if len(payload) > cap:
+        raise ValueError(
+            "outgoing message pickles to %d bytes, above the "
+            "network_common.MAX_FRAME_SIZE/MAX_MESSAGE_SIZE caps "
+            "(%d/%d); raise them on BOTH peers for genuinely huge "
+            "control messages" %
+            (len(payload), MAX_FRAME_SIZE, MAX_MESSAGE_SIZE))
     flags = 0
     if len(payload) >= COMPRESS_THRESHOLD:
         packed = gzip.compress(payload, compresslevel=1)
@@ -88,18 +115,28 @@ def send_message(sock, obj, secret=None, nonce=b"", seq=None):
     sock.sendall(_HEADER.pack(len(payload), flags) + payload)
 
 
-def recv_message(sock, secret=None, nonce=b"", seq=None, loads=None):
+def recv_message(sock, secret=None, nonce=b"", seq=None, loads=None,
+                 max_frame=None, max_message=None):
     """Receives one framed message; None on orderly close or (with
     ``secret``) on authentication failure — callers treat both as a
     dead peer and drop the connection.  ``seq`` is the sequence number
     the frame MUST carry (replayed or reordered frames fail the MAC).
     ``loads`` substitutes the deserializer — receivers of
     UNAUTHENTICATED streams (graphics viewers) pass a restricted
-    unpickler so a hostile peer cannot smuggle arbitrary callables."""
+    unpickler so a hostile peer cannot smuggle arbitrary callables.
+    ``max_frame``/``max_message`` cap the raw and decompressed sizes
+    (default :data:`MAX_FRAME_SIZE`/:data:`MAX_MESSAGE_SIZE`);
+    oversize frames also read as a dead peer — the cap is checked
+    BEFORE any payload byte is read or buffered."""
     header = _recv_exact(sock, _HEADER.size)
     if header is None:
         return None
     length, flags = _HEADER.unpack(header)
+    if length > (max_frame if max_frame is not None
+                 else MAX_FRAME_SIZE):
+        from . import resilience
+        resilience.stats.incr("net.oversize")
+        return None
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
@@ -114,8 +151,32 @@ def recv_message(sock, secret=None, nonce=b"", seq=None, loads=None):
         if not hmac_mod.compare_digest(mac, want):
             return None
     if flags & _FLAG_GZIP:
-        payload = gzip.decompress(payload)
+        payload = _bounded_gunzip(
+            payload, max_message if max_message is not None
+            else MAX_MESSAGE_SIZE)
+        if payload is None:
+            from . import resilience
+            resilience.stats.incr("net.oversize")
+            return None
     return (loads or pickle.loads)(payload)
+
+
+def _bounded_gunzip(payload, limit):
+    """Gzip-decompresses with a hard output cap; None on overflow or
+    corrupt input (both mean the peer is hostile or broken)."""
+    d = zlib.decompressobj(wbits=16 + zlib.MAX_WBITS)
+    try:
+        out = d.decompress(payload, limit + 1)
+    except zlib.error:
+        return None
+    if len(out) > limit or d.unconsumed_tail or d.unused_data \
+            or not d.eof:
+        # Oversize, trailing garbage (unused_data — bytes after the
+        # member, incl. a second gzip member our sender never emits),
+        # or a TRUNCATED stream (valid prefix, no terminator) —
+        # partial plaintext must never reach the unpickler.
+        return None
+    return out
 
 
 class Channel(object):
@@ -129,23 +190,34 @@ class Channel(object):
     ``handshake_ack`` and both sides then :meth:`rekey` — every later
     frame is MAC-bound to that session."""
 
-    def __init__(self, sock, secret=None):
+    def __init__(self, sock, secret=None, injector=None):
         self.sock = sock
         self.secret = normalize_secret(secret)
         self.nonce = b""
         self.send_seq = 0
         self.recv_seq = 0
+        #: Fault injector consulted at ``net.send``/``net.recv``
+        #: (resilience.FaultInjector); None falls back to the
+        #: process-wide one, so a ``--chaos`` plan reaches every
+        #: channel without explicit wiring.
+        self.injector = injector
+
+    def _injector(self):
+        from . import resilience
+        return resilience.effective(self.injector)
 
     def rekey(self, nonce):
         self.nonce = nonce
 
     def send(self, obj):
+        self._injector().check("net.send")
         send_message(self.sock, obj, self.secret, nonce=self.nonce,
                      seq=self.send_seq if self.secret else None)
         if self.secret is not None:
             self.send_seq += 1
 
     def recv(self):
+        self._injector().check("net.recv")
         obj = recv_message(self.sock, self.secret, nonce=self.nonce,
                            seq=self.recv_seq if self.secret else None)
         if obj is not None and self.secret is not None:
@@ -172,8 +244,27 @@ def _recv_exact(sock, n):
     return buf
 
 
-def connect(address, timeout=None):
+def connect(address, timeout=None, io_timeout=None):
+    """Dials ``address``.  ``timeout`` bounds the CONNECT only;
+    ``io_timeout`` (default None = blocking) is what the socket runs
+    with afterwards.  Leaving the connect timeout armed was a bug: a
+    worker blocking in ``recv`` for a job longer than the connect
+    timeout got ``socket.timeout``, misread it as a dead peer, and
+    spuriously reconnected.
+
+    TCP keepalive replaces that accidental liveness bound with a
+    deliberate one: a silent partition (peer host power-cycled, NAT
+    state dropped — no FIN/RST ever arrives) surfaces as a dead
+    connection within a few minutes instead of blocking ``recv``
+    forever."""
     host, port = parse_address(address)
     sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(io_timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    for opt, val in (("TCP_KEEPIDLE", 60), ("TCP_KEEPINTVL", 20),
+                     ("TCP_KEEPCNT", 4)):
+        if hasattr(socket, opt):  # platform-dependent knobs
+            sock.setsockopt(socket.IPPROTO_TCP,
+                            getattr(socket, opt), val)
     return sock

@@ -29,10 +29,12 @@ import statistics
 import threading
 import time
 
+from . import resilience
 from .distributable import SniffedLock
 from .logger import Logger
 from .network_common import (Channel, machine_id, normalize_secret,
                              parse_address)
+from .resilience import MasterCrash
 
 
 class SlaveDescription(object):
@@ -85,6 +87,22 @@ class Server(Logger):
             workflow.checksum)
         #: jobs handed out but not yet answered, per slave id
         self._outstanding = {}
+        #: Fault injector (resilience.FaultInjector) consulted at the
+        #: ``master.crash`` point; None falls back to the process-wide
+        #: one (``--chaos`` plan).
+        self.injector = kwargs.get("injector")
+        self._crashed = False
+        #: First master-side exception raised while serving a worker
+        #: (None = clean).  Launcher.run re-raises it so the process
+        #: exits NONZERO — a degraded coordinator must never write a
+        #: results file and read as success.
+        self.failure = None
+        #: live worker channels — a simulated crash must sever them
+        #: abruptly, exactly like a process death would.  Guarded by
+        #: ``_chan_lock``: crash() must also catch a channel whose
+        #: handler registered it concurrently.
+        self._channels = set()
+        self._chan_lock = threading.Lock()
         #: Respawn hook: ``respawn(desc)`` relaunches a dropped
         #: worker (reference: server.py:637-655).
         self.respawn = kwargs.get("respawn")
@@ -128,6 +146,35 @@ class Server(Logger):
         """Blocks until training completes (decision.complete on the
         master workflow stops the server)."""
         self._stop.wait(timeout)
+
+    def _injector_(self):
+        return resilience.effective(self.injector)
+
+    @property
+    def crashed(self):
+        return self._crashed
+
+    def crash(self):
+        """Simulated coordinator process death: every socket dies
+        abruptly, nothing is requeued, no goodbye frames — the ONLY
+        recovery path is a restarted master resuming the newest
+        atomic snapshot (Launcher.resume_latest).  Driven by the
+        ``master.crash`` injection point; also callable directly by
+        chaos tests."""
+        with self._chan_lock:
+            if self._crashed:
+                return
+            self._crashed = True
+            chans = list(self._channels)
+        self.warning("injected coordinator crash — dying abruptly")
+        resilience.stats.incr("master.crash")
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for chan in chans:
+            chan.close()
 
     # -- worker management (reference pause/resume/blacklist) --------------
 
@@ -180,7 +227,9 @@ class Server(Logger):
                             "worker %s exceeded adaptive job timeout "
                             "— blacklisted, requeueing its work",
                             desc.id)
-                        self._outstanding.pop(desc.id, None)
+                        resilience.stats.incr("server.blacklist")
+                        if self._outstanding.pop(desc.id, None):
+                            resilience.stats.incr("server.requeue")
                         self.workflow.drop_slave(desc.id)
 
     # -- protocol ----------------------------------------------------------
@@ -196,11 +245,33 @@ class Server(Logger):
                              args=(conn, addr), daemon=True,
                              name="veles-server-worker").start()
 
+    def _recv_or_none(self, chan):
+        """A frame that cannot be received OR deserialized reads as a
+        dead peer (drop + requeue), never as a master-side failure:
+        the bytes are peer-supplied, so a worker running skewed code
+        (pickle naming a class this master lacks) must only cost
+        itself, not the coordinator."""
+        try:
+            return chan.recv()
+        except (ConnectionError, TimeoutError):
+            return None
+        except Exception as e:
+            self.warning("dropping worker: undeserializable frame "
+                         "(%s)", e)
+            return None
+
     def _serve_slave(self, conn, addr):
         desc = None
-        chan = Channel(conn, self._secret)
+        chan = Channel(conn, self._secret, injector=self.injector)
+        with self._chan_lock:
+            self._channels.add(chan)
+            crashed = self._crashed
+        if crashed:
+            # Raced past crash(): a dead master serves nobody.
+            chan.close()
+            return
         try:
-            hello = chan.recv()
+            hello = self._recv_or_none(chan)
             if not hello or hello.get("cmd") != "handshake":
                 return
             # Checksum verification (reference: server.py:484-493).
@@ -232,14 +303,41 @@ class Server(Logger):
             self.info("worker %s joined (power %.1f)", sid,
                       desc.power)
             self._message_loop(chan, desc)
+        except MasterCrash:
+            self.crash()
+        except (ConnectionError, TimeoutError):
+            # Dead peer mid-protocol (broken pipe on a send, a
+            # keepalive timeout, or an injected net fault): identical
+            # to a recv()→None close — the finally below drops and
+            # requeues.
+            pass
+        except Exception:
+            # NOT a peer problem: a master-side failure raised while
+            # applying this worker's traffic (exhausted snapshot-write
+            # retries, loader I/O error, ...).  Swallowing it as a
+            # dead peer would silently requeue forever; the contract
+            # is a LOUD stop.  (During shutdown/crash the racing
+            # EBADF from our own close is expected noise, not a
+            # failure.)
+            if not self._stop.is_set():
+                import sys
+                self.failure = sys.exc_info()[1]
+                self.exception(
+                    "master-side error while serving worker %s — "
+                    "stopping coordinator", desc.id if desc else addr)
+                self.stop()
         finally:
+            with self._chan_lock:
+                self._channels.discard(chan)
             chan.close()
-            if desc is not None:
+            # A crashed master does NOT requeue or respawn — it is
+            # dead; cleanup is the restarted master's job.
+            if desc is not None and not self._crashed:
                 self._drop(desc)
 
     def _message_loop(self, chan, desc):
         while not self._stop.is_set():
-            msg = chan.recv()
+            msg = self._recv_or_none(chan)
             if msg is None:
                 return
             cmd = msg.get("cmd")
@@ -284,7 +382,15 @@ class Server(Logger):
 
     def _generate_job(self, desc):
         """Serializes one job under the workflow lock
-        (reference: server.py:596-611 deferred generation)."""
+        (reference: server.py:596-611 deferred generation).  The
+        ``job`` chaos counter ticks per job actually GENERATED —
+        never on no_job polls, whose count is wall-clock-dependent —
+        so a plan like ``master.crash@job:7`` crashes the coordinator
+        at the exact same ledger position every run.  The crash fires
+        before the job is recorded as outstanding or dispatched; the
+        consumed workflow state rolls back through the snapshot on
+        resume."""
+        inj = self._injector_()
         with self._lock:
             if self._finished_locked():
                 return None
@@ -295,6 +401,8 @@ class Server(Logger):
                 # caller sends no_job; counting it as outstanding
                 # would block _maybe_finished forever.
                 return None
+            inj.tick("job")
+            inj.check("master.crash")
             self._outstanding[desc.id] = \
                 self._outstanding.get(desc.id, 0) + 1
             return data
@@ -305,6 +413,9 @@ class Server(Logger):
         have blacklisted this worker (and requeued its job) between
         the handler reading the frame and getting here — applying
         the late result then would double-count the batch."""
+        inj = self._injector_()
+        inj.tick("update")
+        inj.check("master.crash")
         with self._lock:
             if desc.blacklisted:
                 return False
@@ -341,8 +452,10 @@ class Server(Logger):
         worker."""
         with self._lock:
             self._slaves.pop(desc.id, None)
-            self._outstanding.pop(desc.id, None)
+            if self._outstanding.pop(desc.id, None):
+                resilience.stats.incr("server.requeue")
             self.workflow.drop_slave(desc.id)
+        resilience.stats.incr("server.drop")
         self.info("worker %s dropped", desc.id)
         self._maybe_respawn(desc)
 
@@ -367,6 +480,7 @@ class Server(Logger):
                 return
             self.info("respawning worker for %s (attempt %d)", mid,
                       count + 1)
+            resilience.stats.incr("server.respawn")
             try:
                 self.respawn(desc)
             except Exception:

@@ -23,8 +23,10 @@ import os
 import pickle
 import time
 
+from . import resilience
 from .config import root, get as config_get
 from .registry import MappedUnitRegistry
+from .resilience import RetryPolicy
 from .units import Unit
 
 def init_parser(parser):
@@ -41,6 +43,12 @@ def init_parser(parser):
     parser.add_argument(
         "--no-snapshots", action="store_true",
         help="disable snapshotting for this run")
+    parser.add_argument(
+        "--auto-resume", action="store_true",
+        help="coordinator crash-resume: if the snapshot directory "
+             "holds a *_current.lnk pointer, resume from the newest "
+             "snapshot instead of starting fresh (no-op when -s is "
+             "given or no snapshot exists)")
 
 
 CODECS = {
@@ -146,6 +154,21 @@ class SnapshotterToFile(SnapshotterBase):
         self.directory = kwargs.get(
             "directory",
             config_get(root.common.dirs.snapshots, "snapshots"))
+        #: Transient write failures (NFS hiccup, injected
+        #: ``snapshot.fail``) are retried with backoff; exhaustion
+        #: propagates — a training run silently losing its
+        #: checkpoints is worse than a loud stop.
+        self.retry_policy = kwargs.get("retry_policy") or RetryPolicy(
+            max_attempts=int(kwargs.get("write_retries", 3)),
+            base_delay=0.05)
+        #: Fault injector consulted at ``snapshot.write``; None =
+        #: the process-wide one.  Trailing underscore: transient —
+        #: injectors hold locks and never ride a snapshot.
+        self.injector_ = kwargs.get("injector")
+
+    def init_unpickled(self):
+        super(SnapshotterToFile, self).init_unpickled()
+        self.injector_ = None
 
     def export(self):
         os.makedirs(self.directory, exist_ok=True)
@@ -154,11 +177,14 @@ class SnapshotterToFile(SnapshotterBase):
         if self.suffix:
             name += "_" + self.suffix
         path = os.path.join(self.directory, name + ".pickle" + ext)
-        with opener(path) as fout:
-            pickle.dump(self.workflow, fout,
-                        protocol=pickle.HIGHEST_PROTOCOL)
+        self.retry_policy.call(
+            lambda: self._write_atomic(opener, path),
+            retry_on=(OSError,), stat="snapshot.retry",
+            on_retry=lambda attempt, e: self.warning(
+                "snapshot write failed (%s) — retrying", e))
         self.destination = path
         self._update_current_link(path)
+        resilience.stats.incr("snapshot.write")
         size = os.path.getsize(path)
         self.info("snapshot -> %s (%.1f MB)", path, size / 1e6)
         if size > (1 << 30):
@@ -166,13 +192,39 @@ class SnapshotterToFile(SnapshotterBase):
                          "unit state (reference kept a per-unit size "
                          "breakdown for this)")
 
+    def _write_atomic(self, opener, path):
+        """Pickles into a temp file in the same directory, then
+        ``os.replace``s it over the target: a crash mid-pickle can
+        never clobber the previous good snapshot at the same path —
+        the invariant coordinator crash-resume rests on."""
+        resilience.effective(self.injector_).check("snapshot.write")
+        tmp = path + ".part"
+        try:
+            with opener(tmp) as fout:
+                pickle.dump(self.workflow, fout,
+                            protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
     def _update_current_link(self, path):
         """Maintains ``<prefix>_current.lnk`` with the newest snapshot
-        path (reference: snapshotter.py:395-407)."""
+        path (reference: snapshotter.py:395-407).  Atomic for the
+        same reason as the snapshot itself: the pointer is what a
+        restarted coordinator trusts."""
         link = os.path.join(self.directory,
                             self.prefix + "_current.lnk")
-        with open(link, "w") as fout:
-            fout.write(path)
+        tmp = link + ".part"
+        with open(tmp, "w") as fout:
+            # Absolute: a coordinator restarted from a different cwd
+            # (supervisors rarely preserve it) must still find the
+            # snapshot the pointer names.
+            fout.write(os.path.abspath(path))
+        os.replace(tmp, link)
 
     @staticmethod
     def import_(path):

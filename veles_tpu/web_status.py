@@ -158,16 +158,23 @@ class WebStatusServer(JsonHttpServer):
                 runtime = float(info.get("runtime", 0.0))
             except (TypeError, ValueError):
                 runtime = 0.0
+            resilience = info.get("resilience")
+            resilience_row = (
+                "<tr><th>resilience</th><td>%s</td></tr>" %
+                esc(json.dumps(resilience, sort_keys=True))
+                if isinstance(resilience, dict) and resilience
+                else "")
             rows.append(
                 "<h2>%s <small>(%s)</small></h2>"
                 "<table><tr><th>mode</th><td>%s</td></tr>"
                 "<tr><th>epoch</th><td>%s</td></tr>"
                 "<tr><th>runtime</th><td>%.0f s</td></tr>"
-                "<tr><th>metrics</th><td>%s</td></tr></table>" %
+                "<tr><th>metrics</th><td>%s</td></tr>%s</table>" %
                 (esc(info.get("workflow", "?")), esc(mid),
                  esc(info.get("mode", "?")), esc(info.get("epoch", "?")),
                  runtime,
-                 esc(json.dumps(info.get("metrics", {})))) +
+                 esc(json.dumps(info.get("metrics", {}))),
+                 resilience_row) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
                  "</th><th>jobs</th></tr>%s</table>" % wtable
                  if workers else "") +

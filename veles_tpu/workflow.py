@@ -355,6 +355,14 @@ class Workflow(Container):
         for rt, u in stats[:top_number]:
             self.info("  %-24s %8.3fs (%4.1f%%, %d runs)",
                       u.name, rt, 100.0 * rt / total, u.run_count)
+        # Resilience events (retries, drops, blacklists, crashes,
+        # resumes) ride the same stats report so degraded runs are
+        # visible right next to the timing table.
+        from . import resilience
+        events = resilience.stats.snapshot()
+        if events:
+            self.info("Resilience events: %s", "; ".join(
+                "%s=%d" % (k, v) for k, v in sorted(events.items())))
 
     def gather_results(self):
         """Collects metrics from IResultProvider units into a dict
