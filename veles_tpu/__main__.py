@@ -249,6 +249,20 @@ class Main(Logger, CommandLineBase):
                 args.snapshot_compression
         if args.no_snapshots:
             root.common.snapshot_disabled = True
+        # Serving knobs for the in-workflow RESTfulAPI unit
+        # (restful.serving_config_defaults reads these back).
+        if args.serve_max_batch is not None:
+            root.common.serving.max_batch = args.serve_max_batch
+        if args.serve_queue_depth is not None:
+            root.common.serving.queue_depth = args.serve_queue_depth
+        if args.serve_rate_limit is not None:
+            root.common.serving.rate_limit = args.serve_rate_limit
+        if args.serve_deadline is not None:
+            root.common.serving.deadline = args.serve_deadline
+        if args.serve_token is not None:
+            root.common.serving.token = args.serve_token
+        if args.serve_warmup:
+            root.common.serving.warmup = True
 
     def load(self, WorkflowClass, **kwargs):
         """``load`` closure passed to the module's run() hook

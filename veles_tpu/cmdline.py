@@ -162,6 +162,7 @@ class CommandLineBase(object):
 CONTRIBUTING_MODULES = (
     "veles_tpu.client",
     "veles_tpu.loader.base",
+    "veles_tpu.restful",
     "veles_tpu.snapshotter",
 )
 

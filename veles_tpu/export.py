@@ -327,7 +327,7 @@ class ExportedModel(object):
     """Loads an artifact and re-executes its forward chain
     (the Python mirror of the native runtime)."""
 
-    def __init__(self, path):
+    def __init__(self, path, compile_capacity=32):
         with tarfile.open(path, "r:gz") as tar:
             manifest_blob = tar.extractfile("manifest.json").read()
             weights_blob = tar.extractfile("weights.npz").read()
@@ -343,6 +343,43 @@ class ExportedModel(object):
         self.input_shape = tuple(
             self.manifest["input"]["sample_shape"])
         self._jit_forward = None
+        self.compile_capacity = int(compile_capacity)
+        self._compile_cache = None
+
+    @property
+    def compile_cache(self):
+        """The bounded LRU of built executables (generate geometries
+        and forward shape sentinels) — every compile key is
+        client-reachable through the serving endpoints, so the set is
+        hard-capped; evicting a forward sentinel resets the monolithic
+        forward jit (its per-shape cache hides behind one callable)."""
+        if self._compile_cache is None:
+            from .serving.buckets import CompileCache
+
+            def on_evict(key, value):
+                if key and key[0] == "fwd":
+                    # The forward executables all hide behind ONE jit
+                    # callable, so dropping it invalidates every fwd
+                    # sentinel — remove them together or the
+                    # survivors would report cache HITs while
+                    # forward() silently recompiles.
+                    self._jit_forward = None
+                    self._compile_cache.drop_where(
+                        lambda k: k and k[0] == "fwd")
+
+            self._compile_cache = CompileCache(
+                capacity=self.compile_capacity, on_evict=on_evict)
+        return self._compile_cache
+
+    @property
+    def max_position(self):
+        """The LM positional-table size (prompt+generated tokens must
+        fit), or None when the artifact is not a causal LM."""
+        try:
+            emb, _, _ = self._lm_chain()
+        except Bug:
+            return None
+        return int(self.weights[emb["params"]["pos"]].shape[0])
 
     # ---- numpy reference path (native-runtime mirror) -----------------
 
@@ -611,6 +648,26 @@ class ExportedModel(object):
         return numpy.asarray(self._jit_forward(
             numpy.asarray(x, dtype=numpy.float32)))
 
+    def forward_bucketed(self, x, batch_bucket):
+        """Serving forward with the batch dim padded up to
+        ``batch_bucket`` (zeros — rows are independent, pad outputs
+        are dropped), so the compile-key set the serving layer can
+        reach is the bucket grid, not every client batch size.  Shape
+        sentinels ride the LRU compile cache for hit/miss accounting
+        and the hard entry cap (eviction resets the forward jit)."""
+        x = numpy.asarray(x, dtype=numpy.float32)
+        if x.ndim == 1:
+            x = x[None]
+        n = x.shape[0]
+        batch_bucket = max(int(batch_bucket), n)
+        if batch_bucket > n:
+            x = numpy.concatenate(
+                [x, numpy.zeros((batch_bucket - n,) + x.shape[1:],
+                                numpy.float32)], axis=0)
+        self.compile_cache.get_or_build(
+            ("fwd",) + tuple(x.shape), lambda: True)
+        return self.forward(x)[:n]
+
     def _jax_chain(self, x):
         import jax
         import jax.numpy as jnp
@@ -721,6 +778,13 @@ class ExportedModel(object):
         artifact is not a causal LM.  Dropout entries are inert at
         inference and skipped."""
         entries = [e for e in self.units if e["type"] != "dropout"]
+        if any(e["type"] == "moe_transformer_block" for e in entries):
+            # A precise refusal: the routed-expert FFN has no cached
+            # decode path yet, and the generic chain-shape message
+            # would mislead (the chain IS embedding→blocks→head).
+            raise Bug("MoE blocks are not yet supported by "
+                      "generate() — serve moe_transformer_block "
+                      "artifacts through forward()")
         if len(entries) < 3 or entries[0]["type"] != "embedding" or \
                 entries[-1]["type"] != "lm_head" or \
                 any(e["type"] != "transformer_block"
@@ -735,7 +799,8 @@ class ExportedModel(object):
                           "(block %s is bidirectional)" % e["name"])
         return entries[0], entries[1:-1], entries[-1]
 
-    def _cached_block(self, p, x, ck, cv, start, n_heads):
+    def _cached_block(self, p, x, ck, cv, start, n_heads,
+                      key_mask=None):
         """One pre-LN block over a chunk of positions
         [start, start+s) with a (B, L, H, D) KV cache: the chunk's
         k/v are written into the cache, queries attend the WHOLE
@@ -743,7 +808,15 @@ class ExportedModel(object):
         in the masked future by construction).  Used for BOTH
         prefill (s = prompt length, start = 0) and incremental
         decode (s = 1) — one code path, so prefill/decode parity is
-        structural."""
+        structural.
+
+        ``key_mask`` (B, S_, L) overrides the causal mask with a
+        per-BATCH-ELEMENT valid-key mask — the bucketed serving path
+        uses it to exclude each row's pad slots, so coalesced
+        requests of different true lengths cannot see each other's
+        padding (attention is permutation-invariant over key slots:
+        masking pads and keeping logical positions in the embeddings
+        reproduces the unpadded computation exactly)."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -764,12 +837,16 @@ class ExportedModel(object):
         vn = (h @ p["wv"] + p["bv"]).reshape(B, S_, H, D)
         ck = lax.dynamic_update_slice(ck, kn, (0, start, 0, 0))
         cv = lax.dynamic_update_slice(cv, vn, (0, start, 0, 0))
-        qpos = start + jnp.arange(S_)
-        mask = qpos[:, None] >= jnp.arange(L)[None, :]
+        if key_mask is None:
+            qpos = start + jnp.arange(S_)
+            mask = (qpos[:, None] >=
+                    jnp.arange(L)[None, :])[None, :, None, :]
+        else:
+            mask = key_mask[:, :, None, :]
         scores = jnp.einsum(
             "bqhd,bkhd->bqhk", q, ck,
             preferred_element_type=jnp.float32) / (D ** 0.5)
-        scores = jnp.where(mask[None, :, None, :], scores, -1e30)
+        scores = jnp.where(mask, scores, -1e30)
         w = jax.nn.softmax(scores, axis=-1)
         attn = jnp.einsum("bqhk,bkhd->bqhd", w, cv).reshape(B, S_, E)
         x = x + attn @ p["wo"] + p["bo"]
@@ -877,10 +954,13 @@ class ExportedModel(object):
         (B, prompt+new) token array — with ``return_logits``, also
         the (B, new, V) pre-sampling logits (what the parity tests
         compare against the full forward).  Compiles once per
-        (prompt_len, max_new, temperature) geometry; the KV cache
-        makes each decode step O(L·E) instead of re-running the full
-        O(L²) forward (the incremental-serving obligation the
-        reference's RESTful role implies, restful_api.py:78)."""
+        (prompt_len, max_new_tokens) geometry — temperature is a
+        TRACED input, deliberately excluded from the compile-cache
+        key (a serving client could otherwise force a fresh
+        multi-second jit per distinct float); the KV cache makes each
+        decode step O(L·E) instead of re-running the full O(L²)
+        forward (the incremental-serving obligation the reference's
+        RESTful role implies, restful_api.py:78)."""
         import jax
         import jax.numpy as jnp
         prompt = numpy.atleast_2d(
@@ -893,19 +973,13 @@ class ExportedModel(object):
         if not numpy.isfinite(temperature) or temperature < 0.0:
             raise Bug("temperature must be finite and >= 0")
         # Compile cache keyed ONLY by geometry (temperature is a
-        # traced input), bounded FIFO — the key is client-reachable
+        # traced input), bounded LRU — the key is client-reachable
         # through the serving endpoint, so it must not grow without
         # bound.
-        cache_key = (prompt.shape[1], int(max_new_tokens))
-        cache = getattr(self, "_gen_cache", None)
-        if cache is None:
-            cache = self._gen_cache = {}
-        fn = cache.get(cache_key)
-        if fn is None:
-            if len(cache) >= 8:
-                cache.pop(next(iter(cache)))
-            fn = cache[cache_key] = self._build_generate(
-                prompt.shape[1], int(max_new_tokens))
+        S0, max_new = prompt.shape[1], int(max_new_tokens)
+        fn = self.compile_cache.get_or_build(
+            ("gen", S0, max_new),
+            lambda: self._build_generate(S0, max_new))
         tokens, logits = fn(prompt, jax.random.PRNGKey(seed),
                             jnp.float32(temperature))
         tokens = numpy.asarray(tokens)
@@ -913,6 +987,164 @@ class ExportedModel(object):
         if return_logits:
             return full, numpy.asarray(logits)
         return full
+
+    # ---- shape-bucketed serving decode --------------------------------
+
+    def _build_generate_bucketed(self, S0b, max_new):
+        """Jitted (prompts, lengths, seeds, temperatures) → generated
+        tokens for a PADDED prompt bucket: prompts are right-padded
+        to ``S0b`` columns and each row carries its true length.
+
+        Exactness argument (what makes coalescing different-length
+        requests safe): right-padding keeps every real prompt token
+        at its true position 0..len-1, so prefill under the plain
+        causal mask is bit-identical for real positions; the first
+        logits are gathered per row at position len-1; each decode
+        step embeds the new token at its LOGICAL position (len+j,
+        per row) while writing its K/V into the uniform cache slot
+        S0b+j, and the per-row key mask admits exactly {real prompt
+        slots} ∪ {generated slots so far}.  Attention is permutation-
+        invariant over key slots, so excluding pad slots and keeping
+        logical positions reproduces the unpadded computation
+        exactly — greedy decode matches ``generate()`` bit-for-bit.
+        (Sampling draws per-ROW keys here — deterministic per seed,
+        but a different stream than the single-key batch draw of
+        ``generate()``.)"""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        emb, blocks, head = self._lm_chain()
+        emb_w = jnp.asarray(self.weights[emb["params"]["weights"]])
+        emb_pos = jnp.asarray(self.weights[emb["params"]["pos"]])
+        head_w = self.weights[head["params"]["weights"]]
+        head_b = self.weights[head["params"]["bias"]] \
+            if "bias" in head["params"] else None
+        block_params = [
+            {n: self.weights[e["params"][n]] for n in e["params"]}
+            for e in blocks]
+        n_heads = [int(e["config"]["n_heads"]) for e in blocks]
+        P = emb_pos.shape[0]
+        if S0b > P:
+            raise Bug("prompt bucket %d exceeds the model's "
+                      "positional table (%d)" % (S0b, P))
+        E = emb_w.shape[1]
+        L = S0b + max_new
+        V = emb_w.shape[0]
+
+        def logits_of(x_last):
+            y = x_last @ head_w
+            return y + head_b if head_b is not None else y
+
+        def sample_rows(logits, keys, temps):
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jax.vmap(jax.random.categorical)(
+                keys, scaled).astype(jnp.int32)
+            return jnp.where(temps > 0.0, sampled, greedy)
+
+        def run(prompts, lengths, seeds, temps):
+            B = prompts.shape[0]
+            keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
+            t = jnp.clip(prompts.astype(jnp.int32), 0, V - 1)
+            x = emb_w[t] + emb_pos[:S0b]
+            caches = []
+            for p, H in zip(block_params, n_heads):
+                ck = jnp.zeros((B, L, H, E // H), jnp.float32)
+                cv = jnp.zeros((B, L, H, E // H), jnp.float32)
+                x, ck, cv = self._cached_block(p, x, ck, cv, 0, H)
+                caches.append((ck, cv))
+            idx = jnp.clip(lengths - 1, 0, S0b - 1)
+            first_logits = logits_of(x[jnp.arange(B), idx])
+            tok0 = sample_rows(
+                first_logits,
+                jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys0),
+                temps)
+            slots = jnp.arange(L)
+
+            def body(carry, j):
+                prev_tok, caches = carry
+                slot = S0b + j
+                # Logical position (len+j per row) for the embedding;
+                # clipped so bucket-overrun junk steps (a neighbor in
+                # the batch wanted more tokens) read in-bounds and
+                # stay discardable instead of faulting.
+                posn = jnp.clip(lengths + j, 0, P - 1)
+                pe = jnp.take(emb_pos, posn, axis=0)
+                xj = emb_w[jnp.clip(prev_tok, 0, V - 1)][:, None] \
+                    + pe[:, None]
+                kmask = ((slots[None, :] < lengths[:, None]) |
+                         ((slots[None, :] >= S0b) &
+                          (slots[None, :] <= slot)))[:, None, :]
+                new_caches = []
+                for (ck, cv), p, H in zip(caches, block_params,
+                                          n_heads):
+                    xj, ck, cv = self._cached_block(
+                        p, xj, ck, cv, slot, H, key_mask=kmask)
+                    new_caches.append((ck, cv))
+                logits = logits_of(xj[:, 0])
+                tok = sample_rows(
+                    logits,
+                    jax.vmap(lambda k: jax.random.fold_in(k, j + 1))(
+                        keys0),
+                    temps)
+                return (tok, new_caches), prev_tok
+
+            if max_new > 1:
+                (last_tok, _), toks = lax.scan(
+                    body, (tok0, caches), jnp.arange(max_new - 1))
+                return jnp.concatenate(
+                    [toks.swapaxes(0, 1), last_tok[:, None]], axis=1)
+            return tok0[:, None]
+
+        return jax.jit(run)
+
+    def generate_bucketed(self, prompts, lengths, max_new_tokens,
+                          temperatures=0.0, seeds=0):
+        """The serving engine's coalesced decode entry point:
+        ``prompts`` (B, S0b) right-padded int32, ``lengths`` (B,)
+        true prompt lengths, scalar-or-(B,) ``temperatures`` /
+        ``seeds``.  Returns the (B, max_new_tokens) GENERATED tokens
+        (the caller holds the true prompts).  Compiles once per
+        (B, S0b, max_new_tokens) bucket triple — with power-of-two
+        bucketing upstream the reachable key set is O(log² span),
+        hard-capped by the LRU compile cache."""
+        prompts = numpy.atleast_2d(
+            numpy.asarray(prompts, dtype=numpy.int32))
+        B, S0b = prompts.shape
+        lengths = numpy.asarray(lengths, dtype=numpy.int32)
+        if lengths.shape != (B,):
+            raise Bug("lengths shape %s does not match batch %d" %
+                      (lengths.shape, B))
+        if S0b < 1 or (lengths < 1).any() or (lengths > S0b).any():
+            raise Bug("prompt lengths must lie in [1, %d]" % S0b)
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise Bug("max_new_tokens must be >= 1")
+        temps = numpy.ascontiguousarray(numpy.broadcast_to(
+            numpy.asarray(temperatures, numpy.float32), (B,)))
+        if not numpy.isfinite(temps).all() or (temps < 0.0).any():
+            raise Bug("temperature must be finite and >= 0")
+        seeds = numpy.ascontiguousarray(numpy.broadcast_to(
+            numpy.asarray(seeds, numpy.uint32), (B,)))
+        limit = self.max_position
+        # The bucket must fit the positional table (prefill embeds
+        # 0..S0b-1) and every row must have room for at least one
+        # generated token.  max_new is a BUCKET, deliberately not
+        # validated against the table: decode steps whose logical
+        # position would overrun it read clamped embeddings and
+        # produce junk a caller slices away — the serving engine
+        # validates each request's TRUE (len + max_new) eagerly, so
+        # one long-decode neighbor cannot 400 a whole coalesced
+        # batch.
+        if limit is not None and (S0b > limit or
+                                  int(lengths.max()) >= limit):
+            raise Bug(
+                "prompt of %d tokens exceeds the model's positional "
+                "table (%d)" % (max(S0b, int(lengths.max())), limit))
+        fn = self.compile_cache.get_or_build(
+            ("genb", B, S0b, max_new),
+            lambda: self._build_generate_bucketed(S0b, max_new))
+        return numpy.asarray(fn(prompts, lengths, seeds, temps))
 
     @staticmethod
     def _jax_pool(t, cfg, x):

@@ -22,7 +22,8 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         if self.outer is not None:
             self.outer.debug("http: " + fmt, *args)
 
-    def reply(self, code, obj, ctype="application/json"):
+    def reply(self, code, obj, ctype="application/json",
+              headers=None):
         if isinstance(obj, (dict, list)):
             blob = dumps_json(obj).encode()
         elif isinstance(obj, str):
@@ -32,12 +33,57 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(blob)))
+        if headers:
+            for name, value in headers.items():
+                self.send_header(name, str(value))
         self.end_headers()
         self.wfile.write(blob)
 
+    def client_id(self):
+        """The admission-control identity of this connection: the
+        remote address (one shared limiter bucket per host — NAT'd
+        crowds share fate, which is the conservative direction for
+        backpressure)."""
+        return self.client_address[0]
+
+    #: Request-body cap.  Bodies are drained before auth/rate-limit
+    #: replies (closing an unread socket resets the client), so an
+    #: unauthenticated Content-Length must not be able to buffer
+    #: gigabytes per connection (PR 1 capped network frames for the
+    #: same reason).
+    MAX_BODY = 64 << 20
+
     def read_json(self):
         length = int(self.headers.get("Content-Length", 0))
+        if length < 0 or length > self.MAX_BODY:
+            # Negative lengths matter too: rfile.read(-1) blocks
+            # until client EOF, pinning a handler thread forever.
+            raise ValueError(
+                "request body of %d bytes exceeds the %d-byte cap" %
+                (length, self.MAX_BODY))
         return json.loads(self.rfile.read(length) or b"{}")
+
+    def check_token(self, token):
+        """Constant-time shared-secret check of the X-Status-Token
+        header.  Bytes, not str: ``compare_digest`` raises TypeError
+        on non-ASCII str operands.  latin-1 is the exact inverse of
+        http.server's header decode (recovers the client's wire
+        bytes losslessly); the token matches as its UTF-8 bytes —
+        what curl-style clients send.  One copy here so the serving
+        and web-status gates cannot drift apart."""
+        import hmac
+        return hmac.compare_digest(
+            (self.headers.get("X-Status-Token") or "")
+            .encode("latin-1"),
+            token.encode("utf-8"))
+
+
+class _ThreadingHTTPServer(ThreadingHTTPServer):
+    """The stock server with a serving-grade listen backlog — the
+    socketserver default of 5 resets connections under a burst of
+    concurrent clients before the accept loop ever sees them."""
+
+    request_queue_size = 128
 
 
 class JsonHttpServer(Logger):
@@ -48,7 +94,7 @@ class JsonHttpServer(Logger):
                  thread_name="veles-http"):
         super(JsonHttpServer, self).__init__()
         handler_cls.outer = self
-        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self._httpd = _ThreadingHTTPServer((host, port), handler_cls)
         self.port = self._httpd.server_address[1]
         self._thread = None
         self._thread_name = thread_name

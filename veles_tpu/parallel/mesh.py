@@ -126,7 +126,12 @@ def _transformer_tp_plan(unit, n_model, model_axis):
         return PartitionSpec(*axes)
 
     if isinstance(unit, (TransformerBlock, PipelinedTransformerStack)):
-        embed = unit.input.shape[-1]
+        inp = getattr(unit, "input", None)
+        if inp is None or inp.shape is None:
+            # Pre-initialize sharding (no linked input yet): degrade
+            # to replicated instead of raising AttributeError.
+            return None
+        embed = inp.shape[-1]
         hidden = embed * unit.mlp_ratio
         if embed % n_model or hidden % n_model or \
                 unit.n_heads % n_model:

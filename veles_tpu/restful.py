@@ -4,29 +4,87 @@ Capability parity with the reference REST stack (reference:
 veles/restful_api.py:78-217 — ``RESTfulAPI`` unit exposing a trained
 workflow as HTTP POST /api, base64 or JSON-array inputs, prediction
 out; paired input feed loader/restful.py:52): here serving runs from
-the EXPORTED artifact (export.py) through the jitted jax chain — the
-server compiles the forward once per batch shape and answers from
-device, so the same artifact serves on TPU or CPU and the training
-process does not have to stay alive (the reference kept the whole
-Twisted workflow process up to serve).
+the EXPORTED artifact (export.py) through the jitted jax chain — and,
+past the reference's one-request-one-forward Twisted handler, through
+the :mod:`veles_tpu.serving` subsystem: HTTP threads only enqueue
+into a bounded queue; a dedicated device thread coalesces compatible
+requests into shape-bucketed padded batches (per-request masking), so
+the compile surface is a small fixed bucket grid and throughput
+scales with batch occupancy instead of request count.  Admission
+control fronts the queue: per-client token-bucket rate limiting,
+429 + ``Retry-After`` backpressure when the queue is at depth, and
+per-request deadlines that cancel abandoned work.  ``GET /stats``
+exposes queue depth, batch occupancy, compile-cache hits/misses, and
+p50/p99 latency; ``GET /health`` never touches the device, so it
+answers while the queue drains.
 
 Two forms:
 
 * :class:`ModelServer` — standalone: ``ModelServer(artifact).serve()``
-  or ``python -m veles_tpu.serve model.veles.tgz --port 8180``.
+  or ``python -m veles_tpu.serve model.veles.tgz --port 8180``
+  (operator flags: ``--warmup`` precompiles the bucket grid,
+  ``--max-batch`` bounds coalescing, ``--rate-limit`` enables the
+  per-client token bucket, ``--token`` gates ``/api/generate``).
 * :class:`RESTfulAPI` — a Unit linked after training: on its first
   run it exports its workflow's forward chain and starts serving in a
-  background thread (the reference's in-workflow form).
+  background thread (the reference's in-workflow form).  The same
+  knobs arrive as kwargs, with CLI defaults via ``--serve-*`` flags
+  (``root.common.serving`` in the config tree).
 """
 
 import base64
 
 import numpy
 
+from .config import root
 from .error import Bug
 from .export import ExportedModel, export_workflow
 from .http_common import JsonHttpServer, JsonRequestHandler
+from .resilience import Deadline
+from .serving import AdmissionError, RateLimiter, ServingEngine
 from .units import Unit
+
+
+def init_parser(parser):
+    """Serving flags for the in-workflow :class:`RESTfulAPI` unit,
+    aggregated into the velescli parser (handed off through
+    ``root.common.serving`` by ``__main__.apply_subsystem_flags``)."""
+    parser.add_argument(
+        "--serve-max-batch", type=int, default=None, metavar="N",
+        help="serving: max rows coalesced into one device batch "
+             "(default 8)")
+    parser.add_argument(
+        "--serve-queue-depth", type=int, default=None, metavar="N",
+        help="serving: bounded request-queue depth; requests beyond "
+             "it get 429 + Retry-After (default 64)")
+    parser.add_argument(
+        "--serve-rate-limit", type=float, default=None, metavar="R",
+        help="serving: per-client token-bucket rate in requests/s "
+             "(default: no limit)")
+    parser.add_argument(
+        "--serve-deadline", type=float, default=None, metavar="SEC",
+        help="serving: per-request deadline; expired requests are "
+             "cancelled unserved (default 30)")
+    parser.add_argument(
+        "--serve-token", default=None, metavar="SECRET",
+        help="serving: require X-Status-Token on /api/generate (the "
+             "same shared-secret scheme web_status uses)")
+    parser.add_argument(
+        "--serve-warmup", action="store_true",
+        help="serving: precompile the shape-bucket grid at startup "
+             "so the first request never pays an XLA compile")
+
+
+def serving_config_defaults():
+    """Serving kwargs from ``root.common.serving`` (populated by the
+    ``--serve-*`` flags); explicit unit kwargs win."""
+    out = {}
+    for key in ("max_batch", "queue_depth", "rate_limit", "deadline",
+                "token", "warmup"):
+        value = root.common.serving.get(key)
+        if value is not None:
+            out[key] = value
+    return out
 
 
 def _decode_input(payload, input_shape):
@@ -53,12 +111,22 @@ def _decode_input(payload, input_shape):
 
 
 class ModelServer(JsonHttpServer):
-    """Serves an exported artifact over HTTP."""
+    """Serves an exported artifact over HTTP through the serving
+    engine (bounded queue, dynamic batching, admission control)."""
 
-    def __init__(self, model, host="0.0.0.0", port=8180):
+    def __init__(self, model, host="0.0.0.0", port=8180, token=None,
+                 max_batch=8, queue_depth=64, rate_limit=None,
+                 deadline=30.0, warmup=False, policy=None):
         if isinstance(model, str):
             model = ExportedModel(model)
         self.model = model
+        self.token = token
+        self.deadline = deadline
+        self.warmup = warmup
+        self.engine = ServingEngine(
+            model, max_batch=max_batch, queue_depth=queue_depth,
+            policy=policy, default_deadline=deadline)
+        self.limiter = RateLimiter(rate_limit) if rate_limit else None
 
         class Handler(JsonRequestHandler):
             def do_GET(self):
@@ -70,9 +138,40 @@ class ModelServer(JsonHttpServer):
                         "workflow": m.get("workflow"),
                         "units": [u["type"] for u in m["units"]],
                         "input": m["input"], "output": m["output"],
+                        "queue_depth":
+                            outer.engine.queue_depth_now(),
                     })
+                elif self.path == "/stats":
+                    self.reply(200, outer.stats_payload())
                 else:
                     self.reply(404, {"error": "not found"})
+
+            def _admit(self):
+                """Rate-limit gate; replies 429 and returns False
+                when the client's bucket is dry."""
+                outer = self.outer
+                if outer.limiter is None:
+                    return True
+                try:
+                    outer.limiter.admit(self.client_id())
+                    return True
+                except AdmissionError as e:
+                    outer.engine.stats.incr("rejected.rate_limited")
+                    self.reply(e.status, {"error": str(e)},
+                               headers=_retry_headers(e))
+                    return False
+
+            def _deadline(self, payload):
+                """The request's deadline: client-suggested (clamped
+                to the server budget) or the server default."""
+                budget = self.outer.deadline
+                try:
+                    want = float(payload.get("deadline", budget))
+                except (TypeError, ValueError):
+                    want = budget
+                if budget is None:
+                    return Deadline(want) if want else None
+                return Deadline(max(0.0, min(want, budget)))
 
             def do_POST(self):
                 outer = self.outer
@@ -83,20 +182,37 @@ class ModelServer(JsonHttpServer):
                     self.reply(404, {"error": "not found"})
                     return
                 try:
+                    # Read the body BEFORE any early reply — closing
+                    # the socket with the request unread resets the
+                    # client's connection instead of delivering the
+                    # status.
+                    payload = self.read_json()
+                except Exception as e:
+                    self.reply(400, {"error": str(e)})
+                    return
+                if not self._admit():
+                    return
+                try:
                     x = _decode_input(
-                        self.read_json(),
+                        payload,
                         outer.model.manifest["input"]["sample_shape"])
                 except Exception as e:  # malformed request -> 400
                     outer.warning("bad /api request: %s", e)
                     self.reply(400, {"error": str(e)})
                     return
                 try:
-                    probs = outer.model.forward(x)
+                    probs = outer.engine.submit_classify(
+                        x, deadline=self._deadline(payload))
                     flat = probs.reshape(probs.shape[0], -1)
                     self.reply(200, {
                         "output": flat,
                         "labels": numpy.argmax(flat, axis=-1),
                     })
+                except AdmissionError as e:  # backpressure/deadline
+                    self.reply(e.status, {"error": str(e)},
+                               headers=_retry_headers(e))
+                except Bug as e:  # client-shaped fault -> 400
+                    self.reply(400, {"error": str(e)})
                 except Exception:  # server-side fault -> 500
                     outer.exception("/api forward failed")
                     self.reply(500,
@@ -107,16 +223,37 @@ class ModelServer(JsonHttpServer):
                 over an LM artifact: {"tokens": [[...]],
                 "max_new_tokens": N, "temperature": T, "seed": S} →
                 {"tokens": full sequences, "generated": new part}.
-                (The deployment surface the reference's RESTful role
-                implies for a language model, restful_api.py:78.)"""
+                Decode steps of concurrent requests coalesce into
+                shape-bucketed batches on the device thread.  When
+                the server holds a token, the X-Status-Token header
+                must match (the same shared-secret gate web_status
+                uses for graphviz rendering — compile-heavy surfaces
+                are not left open)."""
                 outer = self.outer
                 try:
+                    # Drain the body before any early reply (see
+                    # do_POST).
                     payload = self.read_json()
+                except Exception as e:
+                    self.reply(400, {"error": str(e)})
+                    return
+                if outer.token is not None and \
+                        not self.check_token(outer.token):
+                    self.reply(403, {"error": "bad token"})
+                    return
+                if not self._admit():
+                    return
+                try:
                     tokens = numpy.atleast_2d(numpy.asarray(
                         payload["tokens"], dtype=numpy.int32))
                     max_new = int(payload.get("max_new_tokens", 32))
-                    if not 1 <= max_new <= 4096:
-                        raise Bug("max_new_tokens out of range")
+                    cap = outer.engine.policy.new_cap or 4096
+                    if not 1 <= max_new <= cap:
+                        # Same bound the engine enforces (its
+                        # policy.new_cap) — checked here too so the
+                        # refusal costs no queue slot.
+                        raise Bug("max_new_tokens out of range "
+                                  "(1..%d)" % cap)
                     temperature = float(
                         payload.get("temperature", 0.0))
                     seed = int(payload.get("seed", 0))
@@ -125,9 +262,13 @@ class ModelServer(JsonHttpServer):
                     self.reply(400, {"error": str(e)})
                     return
                 try:
-                    full = outer.model.generate(
+                    full = outer.engine.submit_generate(
                         tokens, max_new, temperature=temperature,
-                        seed=seed)
+                        seed=seed, deadline=self._deadline(payload))
+                except AdmissionError as e:
+                    self.reply(e.status, {"error": str(e)},
+                               headers=_retry_headers(e))
+                    return
                 except Bug as e:
                     # Not-an-LM artifact / over-long request: the
                     # client's problem, with the reason.
@@ -147,32 +288,80 @@ class ModelServer(JsonHttpServer):
             Handler, host=host, port=port,
             thread_name="veles-model-server")
 
+    def stats_payload(self):
+        """The /stats body: engine + compile-cache observability."""
+        payload = self.engine.stats.snapshot()
+        payload["queue_depth"] = self.engine.queue_depth_now()
+        payload["max_batch"] = self.engine.max_batch
+        cache = getattr(self.model, "compile_cache", None)
+        if cache is not None:
+            payload["compile_cache"] = cache.stats()
+        if self.limiter is not None:
+            payload["rate_limit"] = {"rate": self.limiter.rate,
+                                     "clients": len(self.limiter)}
+        return payload
+
+    def _spin_up(self):
+        self.engine.start()
+        if self.warmup:
+            self.engine.warmup()
+
+    def start(self):
+        self._spin_up()
+        return super(ModelServer, self).start()
+
     def serve(self):
+        self._spin_up()
         self.info("serving model on port %d (POST /api)", self.port)
         super(ModelServer, self).serve()
+
+    def stop(self):
+        super(ModelServer, self).stop()
+        self.engine.stop()
+
+
+def _retry_headers(e):
+    if e.retry_after is None:
+        return None
+    return {"Retry-After": "%d" % max(1, round(e.retry_after))}
 
 
 class RESTfulAPI(Unit):
     """In-workflow serving unit (reference: restful_api.py:78): link
     it after the Decision; when the workflow finishes training it
-    exports the forward chain and serves until stopped."""
+    exports the forward chain and serves until stopped — through the
+    serving engine (shape-bucketed dynamic batching, admission
+    control), configured by the ``--serve-max-batch`` /
+    ``--serve-queue-depth`` / ``--serve-rate-limit`` /
+    ``--serve-deadline`` / ``--serve-token`` / ``--serve-warmup``
+    CLI flags or the matching kwargs below."""
 
     def __init__(self, workflow, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
+        kwargs = dict(serving_config_defaults(), **kwargs)
         self.host = kwargs.get("host", "0.0.0.0")
         self.port = kwargs.get("port", 8180)
         self.artifact_path = kwargs.get("artifact_path",
                                         "served.veles.tgz")
         self.blocking = kwargs.get("blocking", False)
+        self.max_batch = kwargs.get("max_batch", 8)
+        self.queue_depth = kwargs.get("queue_depth", 64)
+        self.rate_limit = kwargs.get("rate_limit", None)
+        self.deadline = kwargs.get("deadline", 30.0)
+        self.token = kwargs.get("token", None)
+        self.warmup = kwargs.get("warmup", False)
         self.server = None
 
     def run(self):
         if self.server is not None:
             return
         export_workflow(self.workflow, self.artifact_path)
-        self.server = ModelServer(self.artifact_path, host=self.host,
-                                  port=self.port)
+        self.server = ModelServer(
+            self.artifact_path, host=self.host, port=self.port,
+            token=self.token, max_batch=self.max_batch,
+            queue_depth=self.queue_depth, rate_limit=self.rate_limit,
+            deadline=self.deadline, warmup=self.warmup)
         self.port = self.server.port
         if self.blocking:
             self.server.serve()

@@ -1,6 +1,9 @@
 """``python -m veles_tpu.serve model.veles.tgz [--port N]`` — serve an
 exported artifact over HTTP (reference analogue: running a workflow
-under velescli with the RESTfulAPI unit, restful_api.py:78)."""
+under velescli with the RESTfulAPI unit, restful_api.py:78), through
+the production serving engine: shape-bucketed dynamic batching,
+``--warmup`` grid precompilation, per-client rate limiting, and
+queue-depth backpressure (docs/serving.md)."""
 
 import argparse
 import sys
@@ -12,13 +15,40 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="veles_tpu.serve",
         description="Serve an exported veles_tpu model over HTTP "
-                    "(POST /api, GET /health)")
+                    "(POST /api, POST /api/generate, GET /health, "
+                    "GET /stats)")
     parser.add_argument("artifact", help="model .veles.tgz path")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8180)
+    parser.add_argument(
+        "--max-batch", type=int, default=8, metavar="N",
+        help="max rows coalesced into one device batch (default 8)")
+    parser.add_argument(
+        "--queue-depth", type=int, default=64, metavar="N",
+        help="bounded request-queue depth; beyond it requests get "
+             "429 + Retry-After (default 64)")
+    parser.add_argument(
+        "--rate-limit", type=float, default=None, metavar="R",
+        help="per-client token-bucket rate in requests/s "
+             "(default: no limit)")
+    parser.add_argument(
+        "--deadline", type=float, default=30.0, metavar="SEC",
+        help="per-request deadline; expired requests are cancelled "
+             "unserved (default 30)")
+    parser.add_argument(
+        "--token", default=None, metavar="SECRET",
+        help="require X-Status-Token on /api/generate (the same "
+             "shared-secret scheme web_status uses)")
+    parser.add_argument(
+        "--warmup", action="store_true",
+        help="precompile the shape-bucket grid before serving so "
+             "the first request never pays an XLA compile")
     args = parser.parse_args(argv)
-    server = ModelServer(args.artifact, host=args.host,
-                         port=args.port)
+    server = ModelServer(
+        args.artifact, host=args.host, port=args.port,
+        token=args.token, max_batch=args.max_batch,
+        queue_depth=args.queue_depth, rate_limit=args.rate_limit,
+        deadline=args.deadline, warmup=args.warmup)
     try:
         server.serve()
     except KeyboardInterrupt:

@@ -1,0 +1,33 @@
+"""Production serving subsystem.
+
+The reference's deployment story was one Twisted ``RESTfulAPI`` unit —
+one request, one forward, the whole training process kept alive
+(reference restful_api.py:78).  This package is the load-bearing layer
+between the HTTP handlers (:mod:`veles_tpu.restful`) and the device:
+
+* :mod:`~veles_tpu.serving.buckets` — shape-bucketing policy (pad
+  prompt lengths / batch sizes to power-of-two buckets so the jit
+  cache converges to a small fixed key set) and the LRU
+  :class:`~veles_tpu.serving.buckets.CompileCache` with a hard entry
+  cap;
+* :mod:`~veles_tpu.serving.admission` — per-client token-bucket rate
+  limiting, queue-depth backpressure (429 + ``Retry-After``), and
+  deadline errors;
+* :mod:`~veles_tpu.serving.metrics` — queue/batch/latency/compile
+  counters behind the ``/stats`` endpoint;
+* :mod:`~veles_tpu.serving.engine` — the
+  :class:`~veles_tpu.serving.engine.ServingEngine`: a bounded request
+  queue and a dedicated device thread that coalesces compatible
+  requests into padded batches (per-request masking, so stragglers
+  never corrupt a neighbor's result).
+
+Every future inference PR (multi-host serving, KV-cache paging,
+speculative decoding) builds on this layer; see docs/serving.md.
+"""
+
+from .admission import (AdmissionError, DeadlineExceeded,  # noqa: F401
+                        EngineStopped, QueueFull, RateLimited,
+                        RateLimiter, TokenBucket)
+from .buckets import BucketPolicy, CompileCache, next_pow2  # noqa: F401
+from .engine import ServingEngine  # noqa: F401
+from .metrics import ServingStats  # noqa: F401

@@ -1,0 +1,130 @@
+"""Admission control: decide at the door, not on the device.
+
+Three ways a request is refused before it can occupy the device
+thread:
+
+* :class:`RateLimited` — the per-client token bucket is dry (429 +
+  ``Retry-After``);
+* :class:`QueueFull` — the engine's bounded queue is at depth (429 +
+  ``Retry-After`` estimated from the queue's drain rate);
+* :class:`DeadlineExceeded` — the request's deadline (a
+  :class:`veles_tpu.resilience.Deadline` — the PR-1 budget type)
+  expired while it waited; the client has long since hung up, so the
+  device never runs its work (504).
+"""
+
+import collections
+import threading
+import time
+
+
+class AdmissionError(Exception):
+    """A request refused by admission control.  ``status`` is the
+    HTTP code the serving layer replies with; ``retry_after`` (when
+    set) becomes the ``Retry-After`` header in seconds."""
+
+    status = 429
+
+    def __init__(self, message, retry_after=None):
+        super(AdmissionError, self).__init__(message)
+        self.retry_after = retry_after
+
+
+class RateLimited(AdmissionError):
+    """Per-client token bucket exhausted."""
+
+
+class QueueFull(AdmissionError):
+    """The engine's bounded request queue is at depth."""
+
+
+class DeadlineExceeded(AdmissionError):
+    """The request's deadline expired before (or while) the device
+    could serve it — the work is cancelled, not attempted."""
+
+    status = 504
+
+
+class EngineStopped(AdmissionError):
+    """The engine is (being) shut down — the SERVER's state, so the
+    client sees 503 Service Unavailable and retries the restarted
+    instance, never a 400 that tells it to drop the request."""
+
+    status = 503
+
+
+class TokenBucket(object):
+    """A classic token bucket: ``rate`` tokens/second refill up to
+    ``burst``.  ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, rate, burst=None, clock=time.monotonic):
+        self.rate = float(rate)
+        if self.rate <= 0:
+            raise ValueError("rate must be > 0")
+        self.burst = float(burst if burst is not None
+                           else max(1.0, self.rate))
+        self._clock = clock
+        self._tokens = self.burst
+        self._updated = clock()
+
+    def _refill(self):
+        now = self._clock()
+        self._tokens = min(self.burst, self._tokens +
+                           (now - self._updated) * self.rate)
+        self._updated = now
+
+    def try_acquire(self, n=1.0):
+        self._refill()
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    def retry_after(self, n=1.0):
+        """Seconds until ``n`` tokens will be available."""
+        self._refill()
+        short = n - self._tokens
+        return max(0.0, short / self.rate)
+
+
+class RateLimiter(object):
+    """Per-client token buckets with an LRU client cap (a crowd of
+    one-shot clients must not grow the table without bound).  Client
+    identity is whatever string the HTTP layer hands in — the remote
+    address, or an auth-token fingerprint."""
+
+    def __init__(self, rate, burst=None, max_clients=4096,
+                 clock=time.monotonic):
+        self.rate = float(rate)
+        self.burst = burst
+        self.max_clients = int(max_clients)
+        self._clock = clock
+        # OrderedDict as O(1) LRU (most recent last) — a linear
+        # recency scan per request would serialize handler threads
+        # exactly when the table is full (the crowded conditions
+        # rate limiting exists for).
+        self._buckets = collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def admit(self, client):
+        """Raises :class:`RateLimited` when the client's bucket is
+        dry; otherwise consumes one token."""
+        client = str(client)
+        with self._lock:
+            bucket = self._buckets.get(client)
+            if bucket is None:
+                bucket = self._buckets[client] = TokenBucket(
+                    self.rate, self.burst, clock=self._clock)
+                while len(self._buckets) > self.max_clients:
+                    self._buckets.popitem(last=False)
+            else:
+                self._buckets.move_to_end(client)
+            if not bucket.try_acquire():
+                raise RateLimited(
+                    "client %s over the %g req/s limit" %
+                    (client, self.rate),
+                    retry_after=bucket.retry_after())
+
+    def __len__(self):
+        with self._lock:
+            return len(self._buckets)

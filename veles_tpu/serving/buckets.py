@@ -1,0 +1,193 @@
+"""Shape bucketing + the bounded compile cache.
+
+Every distinct input geometry reaching a jitted entry point costs a
+fresh multi-second XLA compile — and request geometry (batch size,
+prompt length, decode budget) is CLIENT-chosen, so an unbucketed
+server hands untrusted input a compile-DoS lever (ADVICE round-5,
+restful.py:105).  The fix is structural, not reactive: round every
+geometry up to a power-of-two bucket so the reachable compile-key set
+is ``O(log span)`` per dimension, precompile that small grid at
+startup (``--warmup``), and keep the built executables in an LRU with
+a hard entry cap.
+"""
+
+import collections
+import threading
+
+
+def next_pow2(n):
+    """The smallest power of two >= n (n >= 1)."""
+    n = int(n)
+    if n < 1:
+        raise ValueError("bucket sizes start at 1, got %d" % n)
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_of(n, floor=1, cap=None):
+    """Rounds ``n`` up to a power-of-two bucket, at least ``floor``.
+    ``cap`` bounds the bucket from above (a positional-table limit, a
+    max batch) but never below ``n`` itself — the caller validates
+    that ``n`` fits at all."""
+    b = next_pow2(max(int(n), int(floor)))
+    if cap is not None:
+        b = min(b, int(cap))
+    return max(b, int(n))
+
+
+class BucketPolicy(object):
+    """The bucket grammar for one serving engine.
+
+    * batch sizes round up to powers of two capped at ``max_batch``;
+    * prompt lengths round up to powers of two with a floor (tiny
+      shapes are not worth distinct executables) and an optional cap
+      (the model's positional table);
+    * decode budgets (``max_new_tokens``) likewise.
+
+    ``grid()`` enumerates the full reachable key set — what
+    ``--warmup`` precompiles so the first real request never pays a
+    compile.
+    """
+
+    def __init__(self, max_batch=8, batch_floor=1, prompt_floor=16,
+                 prompt_cap=None, new_floor=16, new_cap=4096):
+        self.max_batch = int(max_batch)
+        self.batch_floor = int(batch_floor)
+        self.prompt_floor = int(prompt_floor)
+        self.prompt_cap = prompt_cap
+        self.new_floor = int(new_floor)
+        self.new_cap = new_cap
+
+    def batch_bucket(self, n):
+        return bucket_of(n, self.batch_floor, self.max_batch)
+
+    def prompt_bucket(self, s):
+        return bucket_of(s, self.prompt_floor, self.prompt_cap)
+
+    def new_bucket(self, m):
+        return bucket_of(m, self.new_floor, self.new_cap)
+
+    def batch_buckets(self):
+        """All reachable batch buckets, ascending."""
+        out = []
+        b = self.batch_bucket(1)
+        while b < self.max_batch:
+            out.append(b)
+            b *= 2
+        out.append(self.max_batch)
+        return sorted(set(out))
+
+    def prompt_buckets(self, longest):
+        """The prompt buckets covering lengths 1..longest."""
+        out = []
+        s = 1
+        while s <= longest:
+            b = self.prompt_bucket(s)
+            out.append(b)
+            s = b + 1
+        return sorted(set(out))
+
+    def new_buckets(self, largest):
+        """The decode buckets covering budgets 1..largest."""
+        out = []
+        m = 1
+        while m <= largest:
+            b = self.new_bucket(m)
+            out.append(b)
+            m = b + 1
+        return sorted(set(out))
+
+    def grid(self, longest_prompt=None, max_new=None):
+        """(batch, prompt, new) bucket triples for warmup.  Prompt and
+        new dims are included only when their spans are given (dense
+        classify models warm the batch dim alone).  The decode dim
+        covers EVERY bucket up to ``max_new`` — warming only one
+        bucket would leave the others paying the first-request
+        compile the warmup exists to eliminate."""
+        batches = self.batch_buckets()
+        if longest_prompt is None:
+            return [(b, None, None) for b in batches]
+        prompts = self.prompt_buckets(longest_prompt)
+        news = self.new_buckets(self.new_floor if max_new is None
+                                else max_new)
+        return [(b, s, m) for b in batches for s in prompts
+                for m in news]
+
+
+class CompileCache(object):
+    """LRU cache of built (compiled) executables with a HARD entry
+    cap — the compile-key set is client-reachable through the serving
+    endpoints, so it must not grow without bound.  Thread-safe;
+    hit/miss/eviction counters feed the ``/stats`` endpoint.
+
+    ``on_evict(key, value)`` lets the owner drop satellite state tied
+    to an evicted entry (e.g. the model's monolithic forward jit wraps
+    many shapes under one callable — evicting its sentinel resets it).
+    """
+
+    def __init__(self, capacity=32, on_evict=None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.on_evict = on_evict
+        self._entries = collections.OrderedDict()
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get_or_build(self, key, builder):
+        """The cached value for ``key``, building (and possibly
+        evicting the least-recently-used entry) on a miss.  The
+        builder runs OUTSIDE the lock — a multi-second XLA compile
+        must not block other cache users (``/stats`` reads this lock
+        exactly when an operator wants to see what a stalled server
+        is doing).  Two threads racing the same cold key may both
+        build; the first insert wins and one build is discarded —
+        harmless, and the serving engine's single device thread never
+        races itself."""
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return self._entries[key]
+            self.misses += 1
+        value = builder()
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return self._entries[key]
+            self._entries[key] = value
+            while len(self._entries) > self.capacity:
+                old_key, old_value = self._entries.popitem(last=False)
+                self.evictions += 1
+                if self.on_evict is not None:
+                    self.on_evict(old_key, old_value)
+            return value
+
+    def drop_where(self, predicate):
+        """Removes every entry whose key matches, WITHOUT firing
+        ``on_evict`` (this is the owner cleaning up satellite state,
+        not capacity pressure).  Safe to call from inside an
+        ``on_evict`` callback — the lock is re-entrant."""
+        with self._lock:
+            for key in [k for k in self._entries if predicate(k)]:
+                del self._entries[key]
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._entries
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+    def stats(self):
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "entries": len(self._entries),
+                    "capacity": self.capacity}

@@ -1,0 +1,447 @@
+"""The serving engine: HTTP I/O decoupled from device execution.
+
+One dedicated device thread owns the model; HTTP handler threads only
+enqueue.  The device thread drains the bounded queue in arrival
+order, coalescing every compatible waiting request into one padded
+batch (Orca-style continuous batching, adapted to whole-request
+granularity): classify requests sharing a sample width ride one
+``forward``, generate requests sharing a (prompt-bucket, decode-
+bucket) pair ride one ``generate_bucketed`` call with per-request
+length masking — a straggler padded up to the bucket can never
+corrupt a neighbor's result, because masked positions are excluded
+from attention and each row's output is sliced to its own true
+geometry.
+
+Admission is enforced at the door (:mod:`.admission`): a full queue
+raises :class:`~veles_tpu.serving.admission.QueueFull` (the HTTP
+layer turns it into 429 + ``Retry-After``), and a request whose
+deadline expires while queued is cancelled without ever touching the
+device — work the client has abandoned is not worth a TPU millisecond.
+"""
+
+import collections
+import threading
+import time
+
+import numpy
+
+from ..error import Bug
+from ..logger import Logger
+from ..resilience import Deadline
+from .admission import DeadlineExceeded, EngineStopped, QueueFull
+from .buckets import BucketPolicy
+from .metrics import ServingStats
+
+
+class _Request(object):
+    """One queued unit of work.  ``key`` groups coalescible requests;
+    ``rows`` is the device-batch budget it consumes."""
+
+    __slots__ = ("kind", "key", "rows", "x", "tokens", "length",
+                 "max_new", "temperature", "seed", "deadline",
+                 "result", "error", "event", "t_submit")
+
+    def __init__(self, kind, key, rows, deadline):
+        self.kind = kind
+        self.key = key
+        self.rows = rows
+        self.x = None
+        self.tokens = None
+        self.length = 0
+        self.max_new = 0
+        self.temperature = 0.0
+        self.seed = 0
+        self.deadline = deadline
+        self.result = None
+        self.error = None
+        self.event = threading.Event()
+        self.t_submit = time.monotonic()
+
+
+class ServingEngine(Logger):
+    """Bounded queue + device thread + dynamic batching over a model
+    exposing ``forward(x)`` (and, for LM artifacts,
+    ``generate_bucketed(prompts, lengths, max_new, temperatures,
+    seeds)`` — :class:`veles_tpu.export.ExportedModel` provides both;
+    any duck-typed model with the same surface serves too)."""
+
+    def __init__(self, model, max_batch=8, queue_depth=64,
+                 policy=None, stats=None, default_deadline=30.0):
+        super(ServingEngine, self).__init__()
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.queue_depth = int(queue_depth)
+        # Cached once: ExportedModel.max_position re-parses the unit
+        # chain per access, too heavy for the per-request hot path.
+        self._max_position = getattr(model, "max_position", None)
+        self.policy = policy or BucketPolicy(
+            max_batch=self.max_batch,
+            prompt_cap=self._max_position)
+        self.stats = stats or ServingStats()
+        self.default_deadline = default_deadline
+        self._pending = collections.deque()
+        self._cond = threading.Condition()
+        self._thread = None
+        self._stopped = False
+        self._batch_seconds_ewma = None  # recent device-batch cost
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="veles-serving-device")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # Anything still queued is cancelled, not silently dropped —
+        # a blocked submitter must wake with an error (503: the
+        # server's state, retryable, never a client fault).
+        while self._pending:
+            req = self._pending.popleft()
+            req.error = EngineStopped("serving engine stopped")
+            req.event.set()
+
+    def queue_depth_now(self):
+        with self._cond:
+            return len(self._pending)
+
+    def _drain_estimate_locked(self):
+        """Retry-After for a rejected request: how long the current
+        queue should take to drain, from the recent device-batch cost
+        (each drained batch retires up to ``max_batch`` queued
+        requests).  Floors at 1 s; before any batch has run (no
+        signal yet) that floor is all we claim."""
+        ewma = self._batch_seconds_ewma
+        if ewma is None:
+            return 1.0
+        batches = -(-len(self._pending) // max(1, self.max_batch))
+        return min(60.0, max(1.0, batches * ewma))
+
+    # -- submission (HTTP handler threads) ---------------------------------
+
+    def _enqueue(self, req):
+        with self._cond:
+            if self._stopped:
+                raise EngineStopped("serving engine is not running")
+            if len(self._pending) >= self.queue_depth:
+                self.stats.incr("rejected.queue_full")
+                raise QueueFull(
+                    "request queue at depth %d" % self.queue_depth,
+                    retry_after=self._drain_estimate_locked())
+            self._pending.append(req)
+            self._cond.notify()
+        budget = req.deadline.remaining() if req.deadline is not None \
+            else None
+        finished = req.event.wait(
+            timeout=None if budget is None or budget == float("inf")
+            else budget + 60.0)
+        if not finished:
+            # A device-thread stall is the SERVER's fault — surface
+            # it as 504 (DeadlineExceeded), never as a client error.
+            self.stats.incr("stalled.requests")
+            raise DeadlineExceeded(
+                "the device thread did not answer within the "
+                "request budget")
+        if req.error is not None:
+            raise req.error
+        self.stats.observe_request(
+            req.kind, time.monotonic() - req.t_submit)
+        return req.result
+
+    def submit_classify(self, x, deadline=None):
+        """Blocking: a (B, features) float batch through the forward
+        chain; returns the (B, ...) output for exactly these rows.
+        Requests wider than ``max_batch`` are split into sequential
+        chunks (the pre-engine handler accepted any batch size; the
+        engine preserves that, it just bounds DEVICE batches)."""
+        x = numpy.asarray(x, dtype=numpy.float32)
+        if x.ndim == 1:
+            x = x[None]
+        deadline = self._deadline(deadline)
+        if x.shape[0] > self.max_batch:
+            return numpy.concatenate([
+                self.submit_classify(x[at:at + self.max_batch],
+                                     deadline=deadline)
+                for at in range(0, x.shape[0], self.max_batch)],
+                axis=0)
+        req = _Request("classify", ("c",) + tuple(x.shape[1:]),
+                       x.shape[0], deadline)
+        req.x = x
+        return self._enqueue(req)
+
+    def submit_generate(self, tokens, max_new, temperature=0.0,
+                        seed=0, deadline=None):
+        """Blocking: autoregressive decode for one request (possibly
+        multi-row); returns the (B, prompt+max_new) full sequences."""
+        tokens = numpy.atleast_2d(
+            numpy.asarray(tokens, dtype=numpy.int32))
+        max_new = int(max_new)
+        if max_new < 1:
+            # Must be rejected HERE: downstream only ever sees the
+            # decode BUCKET (>= the floor), so a negative/zero budget
+            # would otherwise slice garbage into a 200 response.
+            raise Bug("max_new_tokens must be >= 1")
+        cap = self.policy.new_cap
+        if cap is not None and max_new > cap:
+            # Past the cap, bucket_of degrades to one key per
+            # distinct value — exactly the per-request compile thrash
+            # bucketing exists to prevent — so the cap is a hard
+            # request limit, for direct callers and HTTP alike.
+            raise Bug("max_new_tokens %d exceeds the serving cap "
+                      "(%d)" % (max_new, cap))
+        # Seeds fold into 32 bits (the PRNG key width): an arbitrary-
+        # precision client int must not reach the device thread,
+        # where an int64 overflow would 500 every request coalesced
+        # into the same batch.
+        seed = int(seed) & 0xFFFFFFFF
+        if tokens.shape[0] > self.max_batch:
+            deadline = self._deadline(deadline)
+            return numpy.concatenate([
+                self.submit_generate(
+                    tokens[at:at + self.max_batch], max_new,
+                    temperature=temperature, seed=seed + at,
+                    deadline=deadline)
+                for at in range(0, tokens.shape[0],
+                                self.max_batch)], axis=0)
+        if tokens.shape[1] < 1:
+            raise Bug("prompt must contain at least one token")
+        limit = self._max_position
+        if limit is not None and \
+                tokens.shape[1] + max_new > limit:
+            raise Bug(
+                "prompt %d + %d new tokens exceeds the model's "
+                "positional table (%d)" %
+                (tokens.shape[1], max_new, limit))
+        s_bucket = self.policy.prompt_bucket(tokens.shape[1])
+        m_bucket = self.policy.new_bucket(max_new)
+        if limit is not None:
+            # The padded prefill embeds positions 0..s_bucket-1; a
+            # bucket beyond the table would fail eagerly inside the
+            # build, so clamp here (bucket_of never goes below the
+            # true length).
+            s_bucket = min(s_bucket, limit)
+        req = _Request("generate", ("g", s_bucket, m_bucket),
+                       tokens.shape[0], self._deadline(deadline))
+        req.tokens = tokens
+        req.length = tokens.shape[1]
+        req.max_new = int(max_new)
+        req.temperature = float(temperature)
+        req.seed = int(seed)
+        return self._enqueue(req)
+
+    def _deadline(self, deadline):
+        if deadline is not None:
+            return deadline
+        if self.default_deadline is None:
+            return None
+        return Deadline(self.default_deadline)
+
+    # -- device thread -----------------------------------------------------
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._pending and not self._stopped:
+                    self._cond.wait(0.5)
+                if self._stopped:
+                    return
+                batch = self._take_batch_locked()
+            if batch:
+                self._execute(batch)
+
+    def _take_batch_locked(self):
+        """Head-of-queue plus every compatible waiting request, up to
+        ``max_batch`` device rows.  Later incompatible requests stay
+        queued in order."""
+        head = self._pending.popleft()
+        batch, rows = [head], head.rows
+        for req in list(self._pending):
+            if rows >= self.max_batch:
+                break
+            if req.key == head.key and \
+                    rows + req.rows <= self.max_batch:
+                self._pending.remove(req)
+                batch.append(req)
+                rows += req.rows
+        return batch
+
+    def _cancel(self, req):
+        self.stats.incr("cancelled.deadline")
+        req.error = DeadlineExceeded(
+            "deadline expired after %.3fs in queue" %
+            (time.monotonic() - req.t_submit))
+        req.event.set()
+
+    def _execute(self, batch):
+        live = []
+        for req in batch:
+            if req.deadline is not None and req.deadline.expired:
+                self._cancel(req)
+            else:
+                live.append(req)
+        if not live:
+            return
+        t0 = time.monotonic()
+        try:
+            if live[0].kind == "classify":
+                self._run_classify(live)
+            else:
+                self._run_generate(live)
+            dt = time.monotonic() - t0
+            self.stats.observe_batch(
+                live[0].kind, sum(r.rows for r in live), dt)
+            ewma = self._batch_seconds_ewma
+            self._batch_seconds_ewma = dt if ewma is None \
+                else 0.8 * ewma + 0.2 * dt
+        except Exception as e:
+            for req in live:
+                if req.error is None:
+                    req.error = e
+        finally:
+            for req in live:
+                req.event.set()
+
+    def _run_classify(self, live):
+        x = numpy.concatenate([r.x for r in live], axis=0)
+        n = x.shape[0]
+        bucket = self.policy.batch_bucket(n)
+        fwd = getattr(self.model, "forward_bucketed", None)
+        if fwd is not None:
+            y = numpy.asarray(fwd(x, bucket))
+        else:
+            if bucket > n:
+                pad = numpy.zeros((bucket - n,) + x.shape[1:],
+                                  numpy.float32)
+                x = numpy.concatenate([x, pad], axis=0)
+            y = numpy.asarray(self.model.forward(x))[:n]
+        at = 0
+        for req in live:
+            req.result = y[at:at + req.rows]
+            at += req.rows
+
+    def _run_generate(self, live):
+        _, s_bucket, m_bucket = live[0].key
+        gen_b = getattr(self.model, "generate_bucketed", None)
+        if gen_b is None:
+            # Duck-typed model without the bucketed entry point:
+            # serial fallback, still deadline-aware.
+            for req in live:
+                full = numpy.asarray(self.model.generate(
+                    req.tokens, req.max_new,
+                    temperature=req.temperature, seed=req.seed))
+                req.result = full
+            return
+        rows = sum(r.rows for r in live)
+        b_bucket = self.policy.batch_bucket(rows)
+        prompts = numpy.zeros((b_bucket, s_bucket), numpy.int32)
+        lengths = numpy.ones(b_bucket, numpy.int32)
+        temps = numpy.zeros(b_bucket, numpy.float32)
+        seeds = numpy.zeros(b_bucket, numpy.int64)
+        at = 0
+        for req in live:
+            for i in range(req.rows):
+                prompts[at, :req.length] = req.tokens[i]
+                lengths[at] = req.length
+                temps[at] = req.temperature
+                # Per-row sampling streams: rows of one request fold
+                # the row index into the request seed (independent
+                # draws, deterministic per request), masked to the
+                # 32-bit PRNG key width.
+                seeds[at] = (req.seed + i) & 0xFFFFFFFF
+                at += 1
+        gen = numpy.asarray(gen_b(prompts, lengths, m_bucket,
+                                  temps, seeds))
+        at = 0
+        for req in live:
+            new = gen[at:at + req.rows, :req.max_new]
+            req.result = numpy.concatenate([req.tokens, new], axis=1)
+            at += req.rows
+
+    # -- warmup ------------------------------------------------------------
+
+    #: The HTTP handler's default max_new_tokens — warmup must cover
+    #: the decode bucket a no-field /api/generate request reaches.
+    DEFAULT_MAX_NEW = 32
+
+    def warmup(self, longest_prompt=None, max_new=None):
+        """Precompiles the bucket grid so the first real request
+        never pays an XLA compile.  Dense classify models warm the
+        batch-bucket dim; LM artifacts (``max_position`` known) warm
+        the (batch × prompt × decode) bucket grid too, with the
+        decode span covering the handler's default budget.  Returns
+        the number of entry points warmed."""
+        manifest = getattr(self.model, "manifest", None)
+        compiles = 0
+        self._grow_compile_cache(longest_prompt, max_new)
+        if manifest:
+            features = int(numpy.prod(
+                manifest["input"]["sample_shape"]))
+            fwd = getattr(self.model, "forward_bucketed", None)
+            for b, _, _ in self.policy.grid():
+                x = numpy.zeros((1, features), numpy.float32)
+                try:
+                    if fwd is not None:
+                        fwd(x, b)
+                    else:
+                        self.model.forward(numpy.zeros(
+                            (b, features), numpy.float32))
+                    compiles += 1
+                except Exception as e:
+                    self.warning("classify warmup (batch %d) "
+                                 "failed: %s", b, e)
+                    break
+        limit = self._max_position
+        gen_b = getattr(self.model, "generate_bucketed", None)
+        if limit and gen_b is not None:
+            if max_new is None:
+                max_new = self.DEFAULT_MAX_NEW
+            longest = longest_prompt or max(1, limit - max_new)
+            for b, s, m in self.policy.grid(longest, max_new):
+                s = min(s, limit)
+                prompts = numpy.zeros((b, s), numpy.int32)
+                lengths = numpy.ones(b, numpy.int32)
+                try:
+                    gen_b(prompts, lengths, m,
+                          numpy.zeros(b, numpy.float32),
+                          numpy.zeros(b, numpy.int64))
+                    compiles += 1
+                except Exception as e:
+                    self.warning("generate warmup (%d, %d, %d) "
+                                 "failed: %s", b, s, m, e)
+                    break
+        self.stats.incr("warmup.compiles", compiles)
+        if compiles:
+            self.info("warmup precompiled %d bucket entry points",
+                      compiles)
+        return compiles
+
+    def _grow_compile_cache(self, longest_prompt, max_new):
+        """A compile cache smaller than the warmup grid would evict
+        its own earliest compiles while warming (and thrash forever
+        under traffic spread across the grid) — grow it to hold the
+        whole reachable key set plus slack."""
+        cache = getattr(self.model, "compile_cache", None)
+        if cache is None or not hasattr(cache, "capacity"):
+            return
+        needed = len(self.policy.grid())  # fwd shape sentinels
+        limit = self._max_position
+        if limit:
+            m = self.DEFAULT_MAX_NEW if max_new is None else max_new
+            longest = longest_prompt or max(1, limit - m)
+            needed += len(self.policy.grid(longest, m))
+        needed += 8  # non-bucketed generate() headroom
+        if cache.capacity < needed:
+            self.info("compile cache capacity %d -> %d (warmup grid)",
+                      cache.capacity, needed)
+            cache.capacity = needed

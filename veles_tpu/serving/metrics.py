@@ -1,0 +1,103 @@
+"""Serving observability: the numbers behind ``GET /stats``.
+
+Reuses the PR-1 counter idiom (:class:`veles_tpu.resilience
+.ResilienceStats` — a thread-safe named-counter registry) and adds
+the two serving-specific shapes counters can't carry: a
+batch-occupancy histogram (how full do coalesced batches run?) and
+p50/p99 latency over a sliding window per endpoint.
+"""
+
+import threading
+
+from ..resilience import ResilienceStats
+
+
+class LatencyWindow(object):
+    """A fixed-size ring of recent latencies (seconds) with
+    percentile readout.  A ring, not a reservoir: serving latency is
+    non-stationary (compiles, warmup) and operators want the RECENT
+    distribution."""
+
+    def __init__(self, size=512):
+        self.size = int(size)
+        self._ring = [0.0] * self.size
+        self._n = 0  # total observations ever
+        self._lock = threading.Lock()
+
+    def observe(self, seconds):
+        with self._lock:
+            self._ring[self._n % self.size] = float(seconds)
+            self._n += 1
+
+    def percentile(self, p):
+        """The p-th percentile (0..100) of the window, or None when
+        empty (nearest-rank on the sorted window)."""
+        with self._lock:
+            n = min(self._n, self.size)
+            if n == 0:
+                return None
+            window = sorted(self._ring[:n])
+        rank = min(n - 1, max(0, int(round(p / 100.0 * (n - 1)))))
+        return window[rank]
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._n
+
+
+class ServingStats(object):
+    """Counters + occupancy histogram + latency windows for one
+    engine.  ``snapshot()`` is the ``/stats`` payload body."""
+
+    def __init__(self, window=512):
+        self.counters = ResilienceStats()
+        self._occupancy = {}  # rows-per-executed-batch -> count
+        self._latency = {}  # kind -> LatencyWindow
+        self._window = int(window)
+        self._lock = threading.Lock()
+
+    def incr(self, name, n=1):
+        self.counters.incr(name, n)
+
+    def get(self, name):
+        return self.counters.get(name)
+
+    def observe_batch(self, kind, rows, latency_seconds):
+        """One executed device batch: ``rows`` real rows coalesced,
+        end-to-end device latency in seconds."""
+        self.counters.incr("batches.%s" % kind)
+        with self._lock:
+            self._occupancy[int(rows)] = \
+                self._occupancy.get(int(rows), 0) + 1
+            win = self._latency.get(kind)
+            if win is None:
+                win = self._latency[kind] = LatencyWindow(self._window)
+        win.observe(latency_seconds)
+
+    def observe_request(self, kind, latency_seconds):
+        """One completed request (queue wait + device time)."""
+        self.counters.incr("requests.%s" % kind)
+        key = "request.%s" % kind
+        with self._lock:
+            win = self._latency.get(key)
+            if win is None:
+                win = self._latency[key] = LatencyWindow(self._window)
+        win.observe(latency_seconds)
+
+    def snapshot(self):
+        with self._lock:
+            occupancy = {str(k): v for k, v
+                         in sorted(self._occupancy.items())}
+            latency = {
+                kind: {"count": win.count,
+                       "p50_ms": _ms(win.percentile(50)),
+                       "p99_ms": _ms(win.percentile(99))}
+                for kind, win in self._latency.items()}
+        return {"counters": self.counters.snapshot(),
+                "batch_occupancy": occupancy,
+                "latency": latency}
+
+
+def _ms(seconds):
+    return None if seconds is None else round(seconds * 1000.0, 3)

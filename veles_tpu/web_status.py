@@ -19,7 +19,6 @@ Stale masters (no heartbeat for ``expiry`` seconds) are dropped, the
 reference's garbage-collection behavior.
 """
 
-import hmac
 import html
 import json
 import threading
@@ -67,9 +66,8 @@ class WebStatusServer(JsonHttpServer):
 
             def do_POST(self):
                 outer = self.outer
-                if outer.token is not None and not hmac.compare_digest(
-                        self.headers.get("X-Status-Token") or "",
-                        outer.token):
+                if outer.token is not None and \
+                        not self.check_token(outer.token):
                     self.reply(403, {"error": "bad token"})
                     return
                 try:
