@@ -126,3 +126,42 @@ def test_mnist_probabilistic_death_still_completes():
     assert bool(master.decision.complete)
     assert master.decision.epoch_number == 3
     assert master.total_inflight_jobs() == 0
+
+
+def test_repeated_nan_churn_rollback_recovers_each_time(tmp_path):
+    """Multi-epoch health churn: TWO poisoned train ticks epochs
+    apart under the rollback policy — each one is detected, rolled
+    back to the last good generation, and the run still converges
+    (the standalone-data-plane counterpart of the worker-churn test
+    above)."""
+    from veles_tpu.guardian import HealthGuardian
+
+    prng.reset()
+    resilience.reset()
+    prng.get(0).seed(11)
+    resilience.install("step.nan@30,step.nan@55,seed:7")
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=8, learning_rate=0.1)
+    snap = SnapshotterToFile(wf, directory=str(tmp_path),
+                             prefix="mnist", time_interval=0.0)
+    snap.link_from(wf.decision)
+    snap.gate_skip = ~wf.decision.improved
+    snap.link_attrs(wf.decision, ("suffix", "snapshot_suffix"))
+    guardian = HealthGuardian(wf, policy="rollback", snapshotter=snap,
+                              decision=wf.decision)
+    guardian.link_from(snap)
+    guardian.link_attrs(wf.loader, "minibatch_class",
+                        "last_minibatch", "epoch_number")
+    wf.gds[0].unlink_from(wf.decision)
+    wf.gds[0].link_from(guardian)
+    launcher.initialize()
+    launcher.run()
+    assert resilience.stats.get("chaos.step.nan") == 2
+    assert guardian.rollbacks == 2
+    assert wf.decision.epoch_number == 8
+    assert wf.decision.min_validation_err < 0.10
+    import numpy
+    for layer in wf.forwards:
+        for vec in layer.trainables.values():
+            vec.map_read()
+            assert numpy.isfinite(vec.mem).all()

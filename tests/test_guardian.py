@@ -1,0 +1,274 @@
+"""Training health guardian gates: on-device NaN detection through
+the fused step, the skip / lr_backoff / rollback policies under a
+seeded ``step.nan`` chaos plan, spike detection over the rolling loss
+median, and the decision's empty-epoch accounting guard (fast,
+tier-1 — the multi-epoch churn variants live in test_chaos_e2e.py,
+marked slow)."""
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+import veles_tpu.resilience as resilience
+from veles_tpu.guardian import HealthGuardian, restore_vectors
+from veles_tpu.launcher import Launcher
+from veles_tpu.loader.base import TRAIN, VALID
+from veles_tpu.snapshotter import SnapshotterToFile
+from veles_tpu.workflow import Workflow
+from veles_tpu.znicz.decision import DecisionGD
+from veles_tpu.znicz.samples.mnist import MnistWorkflow
+
+
+def build_guarded(tmp_path, policy, chaos, max_epochs=4, seed=11):
+    """MNIST with an improvement-gated snapshotter and a guardian
+    linked decision → snapshotter → guardian → gd chain."""
+    prng.reset()
+    resilience.reset()
+    prng.get(0).seed(seed)
+    if chaos:
+        resilience.install(chaos)
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=max_epochs,
+                       learning_rate=0.1)
+    # Plain codec + every-4th-trigger throttle: the improvement gate
+    # fires per tick, and 50 gzipped full-workflow pickles would
+    # dominate the test's runtime.  The trigger counter is logical,
+    # so the export schedule stays deterministic.
+    snap = SnapshotterToFile(wf, directory=str(tmp_path),
+                             prefix="mnist", time_interval=0.0,
+                             compression="", interval=4)
+    snap.link_from(wf.decision)
+    snap.gate_skip = ~wf.decision.improved
+    snap.link_attrs(wf.decision, ("suffix", "snapshot_suffix"))
+    guardian = HealthGuardian(wf, policy=policy, snapshotter=snap,
+                              decision=wf.decision)
+    guardian.link_from(snap)
+    guardian.link_attrs(wf.loader, "minibatch_class",
+                        "last_minibatch", "epoch_number")
+    wf.gds[0].unlink_from(wf.decision)
+    wf.gds[0].link_from(guardian)
+    launcher.initialize()
+    launcher.run()
+    return wf, guardian
+
+
+def weights_finite(wf):
+    out = True
+    for layer in wf.forwards:
+        for vec in layer.trainables.values():
+            vec.map_read()
+            out = out and bool(numpy.isfinite(vec.mem).all())
+    return out
+
+
+def test_step_nan_skip_policy_keeps_weights_clean(tmp_path):
+    """A poisoned mid-epoch train tick under the default policy: the
+    device gate drops the NaN update inside the compiled step, the
+    sentinel counts the tick, and training converges regardless."""
+    wf, guardian = build_guarded(tmp_path, "skip", "step.nan@30",
+                                 max_epochs=3)
+    assert resilience.stats.get("chaos.step.nan") == 1
+    assert resilience.stats.get("guardian.nan_ticks") >= 1
+    assert resilience.stats.get("guardian.skipped") >= 1
+    assert guardian.last_event["kind"] == "nan"
+    assert guardian.last_event["action"] == "skipped"
+    assert weights_finite(wf)
+    assert wf.decision.min_validation_err < 0.15
+
+
+def test_step_nan_rollback_restores_and_converges(tmp_path):
+    """The acceptance gate: a seeded chaos plan injecting step.nan
+    mid-epoch yields a run that detects the event, rolls back to the
+    last GOOD snapshot generation (the poisoned generations are
+    rejected via their manifests' finite flag), reshuffles the data
+    order, and still converges — bit-identically across two runs
+    with the same seed."""
+    results = []
+    for run in range(2):
+        directory = tmp_path / ("run%d" % run)
+        wf, guardian = build_guarded(directory, "rollback",
+                                     "step.nan@30,seed:42")
+        assert guardian.rollbacks == 1
+        assert resilience.stats.get("guardian.rollbacks") == 1
+        assert weights_finite(wf)
+        # Detected, recovered, and still converged.
+        assert wf.decision.min_validation_err < 0.15
+        results.append((
+            wf.decision.min_validation_err,
+            [(e["epoch"], e["class"], e["kind"], e["action"])
+             for e in guardian.events],
+            list(resilience.get_injector().fired),
+        ))
+    assert results[0] == results[1]
+
+
+def test_rollback_without_any_snapshot_degrades_to_skip(tmp_path):
+    prng.reset()
+    launcher = Launcher()
+    wf = MnistWorkflow(launcher, max_epochs=1)
+    snap = SnapshotterToFile(wf, directory=str(tmp_path), prefix="x")
+    guardian = HealthGuardian(wf, policy="rollback", snapshotter=snap,
+                              decision=wf.decision)
+    guardian.epoch_number = 1  # normally linked from the loader
+    event = guardian.on_event("nan", TRAIN, "synthetic")
+    assert event["action"] == "skipped"
+    assert resilience.stats.get("guardian.skipped") == 1
+    assert guardian.rollbacks == 0
+
+
+def test_healthy_run_feeds_median_and_spike_backs_off_lr(tmp_path):
+    """Healthy epochs feed the rolling loss median (and never raise
+    events); a finite loss spike (> spike_factor x median) under the
+    lr_backoff policy then halves every GD learning rate and drops
+    the compiled step so the new constants take effect."""
+    wf, guardian = build_guarded(tmp_path, "lr_backoff", "",
+                                 max_epochs=2)
+    # Two clean epochs: no events, the median is armed, and the
+    # on-device grad-norm sentinel produced real numbers.
+    assert guardian.events == []
+    assert len(guardian._loss_history) == 2
+    assert guardian.loss_median() > 0
+    assert wf.decision.epoch_nonfinite == [0.0, 0.0, 0.0]
+    assert wf.decision.epoch_grad_norm[TRAIN] > 0
+    # Synthetic spike at the next train boundary.
+    lr0 = wf.gds[0].learning_rate
+    assert wf.compiler._compiled  # trained: step exists
+    wf.decision.epoch_loss[TRAIN] = \
+        10.0 * guardian.spike_factor * guardian.loss_median()
+    guardian.last_minibatch = True
+    guardian.minibatch_class = TRAIN
+    guardian.run()
+    assert guardian.last_event["kind"] == "spike"
+    assert guardian.last_event["action"] == "lr_backoff"
+    assert wf.gds[0].learning_rate == pytest.approx(lr0 * 0.5)
+    assert wf.compiler._compiled is None  # retrace scheduled
+    assert resilience.stats.get("guardian.lr_backoff") == 1
+
+
+def test_restore_vectors_copies_matching_tensors():
+    prng.reset()
+    prng.get(0).seed(7)
+    a = MnistWorkflow(Launcher(), max_epochs=1)
+    prng.get(0).seed(8)
+    b = MnistWorkflow(Launcher(), max_epochs=1)
+    for wf in (a, b):
+        wf.loader.initialize()
+        for layer in wf.forwards:
+            layer.initialize()
+    restored = restore_vectors(a, b)
+    assert restored >= 4  # two layers x (weights, bias)
+    numpy.testing.assert_array_equal(a.forwards[0].weights.mem,
+                                     b.forwards[0].weights.mem)
+
+
+def test_remote_updates_carry_health_to_the_master():
+    """Master mode: workers ship the sentinel's step_finite/grad_norm
+    with their ordinary metrics; the decision folds them so
+    guardian.check_class sees the same epoch_nonfinite it would
+    standalone."""
+    wf = Workflow(Launcher())
+    decision = DecisionGD(wf)
+    decision.epoch_number = 2
+    for i in range(3):
+        decision.accumulate_remote(
+            TRAIN, {"n_err": 1.0, "n_valid": 10.0, "loss": 0.5,
+                    "step_finite": 1.0, "grad_norm": 2.0}, epoch=1)
+    decision.accumulate_remote(
+        TRAIN, {"n_err": float("nan"), "n_valid": float("nan"),
+                "loss": float("nan"), "step_finite": 0.0,
+                "grad_norm": float("nan")}, epoch=1)
+    decision.finish_remote_class(TRAIN, epoch=1)
+    assert decision.epoch_nonfinite[TRAIN] == 1.0
+    assert decision.epoch_grad_norm[TRAIN] == pytest.approx(2.0)
+    guardian = HealthGuardian(wf, policy="skip", decision=decision)
+    guardian.epoch_number = 2
+    guardian.check_class(TRAIN)
+    assert guardian.last_event["kind"] == "nan"
+    assert resilience.stats.get("guardian.nan_ticks") == 1
+
+
+def test_empty_validation_epoch_is_not_an_improvement():
+    """decision.py satellite: epoch_n_valid == 0 used to read as a
+    perfect 0% error, flip ``improved`` and trigger a bogus
+    snapshot."""
+    wf = Workflow(Launcher())
+    decision = DecisionGD(wf)
+    decision.epoch_number = 1
+    decision.epoch_n_valid[VALID] = 0.0
+    decision.on_last_minibatch(VALID)
+    assert not bool(decision.improved)
+    assert decision.min_validation_err == 1.0e30
+    assert decision.epoch_metrics[VALID] is None
+    # A NaN-poisoned accumulator is skipped the same way.
+    decision.epoch_n_valid[VALID] = float("nan")
+    decision.epoch_n_err[VALID] = float("nan")
+    decision.on_last_minibatch(VALID)
+    assert not bool(decision.improved)
+    assert decision.min_validation_err == 1.0e30
+
+
+def test_guardian_health_rides_payload_and_dashboard():
+    from veles_tpu.web_status import WebStatusServer
+    launcher = Launcher()
+    wf = Workflow(launcher)
+    launcher.add_ref(wf)
+    guardian = HealthGuardian(wf, policy="skip")
+    wf.guardian = guardian
+    guardian.events.append({"epoch": 3, "class": TRAIN, "kind": "nan",
+                            "detail": "2 non-finite tick(s)",
+                            "action": "skipped"})
+    payload = launcher.status_payload("host/1")
+    assert payload["health"]["policy"] == "skip"
+    assert payload["health"]["events"] == 1
+    assert payload["health"]["last_event"]["kind"] == "nan"
+    server = WebStatusServer(port=0)
+    try:
+        server.update(dict(payload, id="host/1"))
+        page = server.render_page()
+        assert "health" in page and "nan" in page
+    finally:
+        server._httpd.server_close()
+    # The exit report mentions the events too (print_stats path).
+    wf.print_stats()
+    # And gather_results carries the counters for --result-file.
+    results = wf.gather_results()
+    assert results["guardian_events"] == 1
+
+
+def test_guardian_cli_flags_registered():
+    from veles_tpu.cmdline import init_argparser
+    parser = init_argparser(prog="t")
+    args = parser.parse_args(
+        ["wf.py", "--guardian-policy", "rollback",
+         "--guardian-spike", "6.5", "--guardian-window", "9",
+         "--snapshot-keep", "5"])
+    assert args.guardian_policy == "rollback"
+    assert args.guardian_spike == 6.5
+    assert args.guardian_window == 9
+    assert args.snapshot_keep == 5
+
+
+def test_standard_workflow_links_guardian():
+    from veles_tpu.znicz.standard_workflow import StandardWorkflow
+    from veles_tpu.znicz.samples.mnist import MnistLoader
+    prng.reset()
+    wf = StandardWorkflow(
+        Launcher(),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": (10,)}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": (10,)}}],
+        loader_cls=MnistLoader,
+        guardian_config={"policy": "rollback"})
+    assert isinstance(wf.guardian, HealthGuardian)
+    assert wf.guardian.policy == "rollback"
+    # decision → guardian → first gd control chain.
+    assert wf.guardian in wf.decision.links_to
+    assert wf.gds[0] in wf.guardian.links_to
+    wf2 = StandardWorkflow(
+        Launcher(),
+        layers=[{"type": "softmax",
+                 "->": {"output_sample_shape": (10,)}}],
+        loader_cls=MnistLoader,
+        guardian_config={"policy": "off"})
+    assert wf2.guardian is None

@@ -179,6 +179,69 @@ def test_update_forge_requires_server():
         UpdateForge().run(None, [])
 
 
+# -- compare_snapshots --verify -----------------------------------------
+
+
+def _fake_snapshot(directory, name, payload, tamper=False,
+                   manifest=True):
+    """A blob + manifest pair without the cost of pickling a real
+    workflow — verify mode only reads files and manifests."""
+    import hashlib
+    import time as time_mod
+    path = os.path.join(str(directory), name)
+    with open(path, "wb") as fout:
+        fout.write(payload)
+    if manifest:
+        from veles_tpu.snapshotter import manifest_path
+        digest = hashlib.sha256(payload).hexdigest()
+        with open(manifest_path(path), "w") as fout:
+            json.dump({"format": 1, "sha256": digest,
+                       "size": len(payload), "prefix": name.split("_")[0],
+                       "codec": "", "created": time_mod.time(),
+                       "finite": True}, fout)
+    if tamper:
+        with open(path, "r+b") as fout:
+            fout.seek(len(payload) // 2)
+            fout.write(b"\xff")
+    return path
+
+
+def test_compare_snapshots_verify_mode(tmp_path):
+    """`--verify` validates a snapshot directory's manifests,
+    checksums, and pointers from the command line, exiting non-zero
+    on any failure — checkpoint integrity as a CI gate."""
+    from veles_tpu.scripts.compare_snapshots import main, verify
+    good = _fake_snapshot(tmp_path, "fam_a.pickle", b"A" * 64)
+    with open(tmp_path / "fam_current.lnk", "w") as fout:
+        fout.write(good)
+    assert main(["--verify", str(tmp_path)]) == 0
+    report = verify(str(tmp_path))
+    assert report["ok"]
+    assert {r["status"] for r in report["rows"]} == {"ok"}
+    # A tampered blob fails the directory.
+    _fake_snapshot(tmp_path, "fam_b.pickle", b"B" * 64, tamper=True)
+    assert main(["--verify", str(tmp_path)]) == 1
+    report = verify(str(tmp_path))
+    statuses = {r["path"].split(os.sep)[-1]: r["status"]
+                for r in report["rows"] if r["path"].endswith(".pickle")}
+    assert statuses["fam_b.pickle"] == "corrupt"
+    assert not report["ok"]
+    # --prefix narrows to one family; the good family still passes.
+    assert main(["--verify", str(tmp_path), "--prefix", "fam_a"]) == 0
+    # A blob without a manifest cannot be proven good.
+    _fake_snapshot(tmp_path, "bare.pickle", b"C" * 8, manifest=False)
+    report = verify(str(tmp_path), prefix="bare")
+    assert report["rows"][-1]["status"] == "no-manifest"
+    assert not report["ok"]
+    # A dangling pointer is reported.
+    with open(tmp_path / "gone_current.lnk", "w") as fout:
+        fout.write(str(tmp_path / "missing.pickle"))
+    report = verify(str(tmp_path))
+    assert any(r["status"] == "dangling" for r in report["rows"])
+    # Single-file mode with --json output.
+    assert main(["--verify", good, "--json"]) == 0
+
+
 def test_generate_docs_covers_units_and_flags(tmp_path):
     """The generated reference (parity role:
     docs/generate_units_args.py) must document transformer kwargs,

@@ -1,14 +1,30 @@
 """Checkpoint/resume gates (reference: veles/snapshotter.py semantics
-+ __main__.py:532-582 resume flow)."""
++ __main__.py:532-582 resume flow), plus the integrity layer:
+checksummed manifests, generation retention, corrupt/unhealthy
+fallback walks, and pointer hardening."""
 
 import os
+import sqlite3
+import time
 
 import numpy
+import pytest
 
 import veles_tpu.prng as prng
+import veles_tpu.resilience as resilience
 from veles_tpu.launcher import Launcher
-from veles_tpu.snapshotter import (SnapshotterToFile,
-                                   SnapshotterRegistry)
+from veles_tpu.memory import Vector
+from veles_tpu.resilience import FaultInjector
+from veles_tpu.snapshotter import (SnapshotterToFile, SnapshotterToDB,
+                                   SnapshotterRegistry,
+                                   SnapshotIntegrityError,
+                                   SnapshotPointerError,
+                                   SnapshotUnhealthyError,
+                                   corrupt_file, iter_generations,
+                                   manifest_path, read_manifest,
+                                   sha256_file)
+from veles_tpu.units import TrivialUnit
+from veles_tpu.workflow import Workflow
 from veles_tpu.znicz.samples.mnist import MnistWorkflow
 
 
@@ -78,8 +94,10 @@ def _build_sharded_lm(tmp_path, max_epochs=2):
     prng.get(0).seed(21)
     launcher = Launcher()
     wf = TinyLMWorkflow(launcher, max_epochs=max_epochs)
+    # keep=0: this test re-imports EARLY generations after further
+    # training — retention pruning (default keep=3) must not eat them.
     snap = SnapshotterToFile(wf, directory=str(tmp_path),
-                             prefix="lm", time_interval=0.0)
+                             prefix="lm", time_interval=0.0, keep=0)
     snap.link_from(wf.decision)
     snap.gate_skip = ~wf.decision.improved
     wf.gds[0].unlink_from(wf.decision)
@@ -154,3 +172,240 @@ def test_snapshot_excludes_launcher(tmp_path):
     launcher.run()
     wf2 = SnapshotterToFile.import_(snap.destination)
     assert wf2.workflow is None  # live launcher not pickled
+
+
+# -- integrity: manifests, retention, generation walks ---------------------
+
+
+class ParamUnit(TrivialUnit):
+    """A unit with one trainable so finiteness checks have teeth."""
+
+    def __init__(self, workflow, value=1.0, **kwargs):
+        super(ParamUnit, self).__init__(workflow, **kwargs)
+        self.w = Vector(numpy.array([value], dtype=numpy.float32))
+
+    @property
+    def trainables(self):
+        return {"w": self.w}
+
+
+class TinyWorkflow(Workflow):
+    """A cheap picklable workflow for integrity tests."""
+
+    def __init__(self, launcher, **kwargs):
+        super(TinyWorkflow, self).__init__(launcher, **kwargs)
+        self.body = ParamUnit(self)
+        self.body.link_from(self.start_point)
+        self.end_point.link_from(self.body)
+        self.tag = 0
+
+
+def tiny_snapshotter(tmp_path, **kwargs):
+    wf = TinyWorkflow(Launcher())
+    kwargs.setdefault("directory", str(tmp_path))
+    kwargs.setdefault("prefix", "tiny")
+    kwargs.setdefault("time_interval", 0.0)
+    kwargs.setdefault("compression", "")
+    snap = SnapshotterToFile(wf, **kwargs)
+    snap.initialize()
+    return wf, snap
+
+
+def export_generations(wf, snap, n, start=0):
+    for i in range(start, start + n):
+        wf.tag = i
+        snap.suffix = "g%d" % i
+        snap.export()
+        time.sleep(0.01)  # distinct manifest timestamps
+
+
+def test_manifest_write_verify_roundtrip(tmp_path):
+    wf, snap = tiny_snapshotter(tmp_path)
+    wf.tag = 7
+    snap.suffix = "one"
+    snap.export()
+    manifest = read_manifest(snap.destination)
+    assert manifest["sha256"] == sha256_file(snap.destination)
+    assert manifest["size"] == os.path.getsize(snap.destination)
+    assert manifest["prefix"] == "tiny"
+    assert manifest["codec"] == ""
+    assert manifest["finite"] is True
+    # verify() returns the manifest; import_ loads the same state.
+    assert SnapshotterToFile.verify(snap.destination)["sha256"] == \
+        manifest["sha256"]
+    assert SnapshotterToFile.import_(snap.destination).tag == 7
+    # Legacy blobs without a manifest still load (unverified).
+    os.unlink(manifest_path(snap.destination))
+    assert SnapshotterToFile.verify(snap.destination) is None
+    assert SnapshotterToFile.import_(snap.destination).tag == 7
+
+
+def test_corrupt_snapshot_rejected_and_resume_walks_back(tmp_path):
+    """A flipped byte must be rejected by manifest verification, and
+    resume must fall back to the previous generation instead of
+    crashing or loading garbage."""
+    wf, snap = tiny_snapshotter(tmp_path)
+    export_generations(wf, snap, 2)
+    newest = snap.destination
+    corrupt_file(newest)
+    with pytest.raises(SnapshotIntegrityError):
+        SnapshotterToFile.import_(newest)
+    assert resilience.stats.get("snapshot.verify_fail") == 1
+    resumed = Launcher().resume_latest(directory=str(tmp_path))
+    assert isinstance(resumed, TinyWorkflow)
+    assert resumed.tag == 0  # the previous good generation
+    # verify=False loads the corrupt bytes' pickle attempt — the
+    # escape hatch is explicit, never the default.
+    with pytest.raises(Exception):
+        SnapshotterToFile.import_(newest, verify=False)
+
+
+def test_chaos_snapshot_corrupt_point(tmp_path):
+    """The seeded snapshot.corrupt chaos point produces exactly the
+    bit-rot scenario: manifest verification rejects the blob, the
+    walk resumes the previous generation."""
+    wf, snap = tiny_snapshotter(tmp_path)
+    export_generations(wf, snap, 1)
+    snap.injector_ = FaultInjector("snapshot.corrupt@1")
+    export_generations(wf, snap, 1, start=1)
+    assert resilience.stats.get("chaos.snapshot.corrupt") == 1
+    with pytest.raises(SnapshotIntegrityError):
+        SnapshotterToFile.verify(snap.destination)
+    resumed = Launcher().resume_latest(directory=str(tmp_path))
+    assert resumed.tag == 0
+
+
+def test_retention_prunes_old_generations(tmp_path):
+    wf, snap = tiny_snapshotter(tmp_path, keep=2)
+    export_generations(wf, snap, 5)
+    gens = iter_generations(str(tmp_path), "tiny")
+    assert [os.path.basename(p) for p in gens] == \
+        ["tiny_g4.pickle", "tiny_g3.pickle"]
+    # Pruned blobs lose their manifests too; the pointer target
+    # (the newest) always survives.
+    files = sorted(os.listdir(tmp_path))
+    assert "tiny_g0.pickle" not in files
+    assert "tiny_g0.pickle.manifest.json" not in files
+    target = SnapshotterToFile.resolve(
+        os.path.join(str(tmp_path), "tiny_current.lnk"))
+    assert os.path.isfile(target)
+    assert resilience.stats.get("snapshot.prune") == 3
+    # keep=0 disables pruning.
+    wf0, snap0 = tiny_snapshotter(tmp_path, keep=0, prefix="un")
+    export_generations(wf0, snap0, 4)
+    assert len(iter_generations(str(tmp_path), "un")) == 4
+
+
+def test_retention_ignores_longer_prefix_families(tmp_path):
+    """A family named tiny_big matches the tiny_* glob; its manifest
+    prefix keeps it off tiny's retention and resume walks."""
+    wf, snap = tiny_snapshotter(tmp_path, keep=2)
+    wf_big, snap_big = tiny_snapshotter(tmp_path, prefix="tiny_big")
+    export_generations(wf_big, snap_big, 1)
+    export_generations(wf, snap, 3)
+    assert len(iter_generations(str(tmp_path), "tiny_big")) == 1
+    assert all("tiny_big" not in os.path.basename(p)
+               for p in iter_generations(str(tmp_path), "tiny"))
+    # Legacy manifest-less blobs of the longer family are protected
+    # too (its _current.lnk declares it): pruning "tiny" must never
+    # delete "tiny_big" checkpoints.
+    big = iter_generations(str(tmp_path), "tiny_big")[0]
+    os.unlink(manifest_path(big))
+    assert all("tiny_big" not in os.path.basename(p)
+               for p in iter_generations(str(tmp_path), "tiny"))
+    assert iter_generations(str(tmp_path), "tiny_big") == [big]
+
+
+def test_dangling_pointer_raises_actionable_error(tmp_path):
+    wf, snap = tiny_snapshotter(tmp_path)
+    export_generations(wf, snap, 2)
+    link = os.path.join(str(tmp_path), "tiny_current.lnk")
+    os.unlink(snap.destination)  # dangle the pointer
+    with pytest.raises(SnapshotPointerError) as e:
+        SnapshotterToFile.import_(link)
+    assert "tiny_current.lnk" in str(e.value)
+    assert "auto-resume" in str(e.value)
+    # --auto-resume walks to the surviving older generation.
+    resumed = Launcher().resume_latest(directory=str(tmp_path))
+    assert resumed.tag == 0
+    # An EMPTY pointer file names itself too.
+    with open(link, "w"):
+        pass
+    with pytest.raises(SnapshotPointerError) as e:
+        SnapshotterToFile.import_(link)
+    assert "empty" in str(e.value)
+    # Still resumable through the generation walk.
+    assert Launcher().resume_latest(directory=str(tmp_path)).tag == 0
+
+
+def test_unhealthy_snapshot_skipped_by_walk(tmp_path):
+    """A snapshot written while trainables were non-finite records
+    finite=false in its manifest; resume and rollback walks skip it
+    like a corrupt one."""
+    wf, snap = tiny_snapshotter(tmp_path)
+    export_generations(wf, snap, 1)
+    wf.body.w.mem = numpy.array([numpy.nan], dtype=numpy.float32)
+    wf.tag = 666
+    snap.suffix = "poisoned"
+    snap.export()
+    assert read_manifest(snap.destination)["finite"] is False
+    with pytest.raises(SnapshotUnhealthyError):
+        SnapshotterToFile.import_(snap.destination)
+    assert resilience.stats.get("snapshot.unhealthy") == 1
+    resumed = Launcher().resume_latest(directory=str(tmp_path))
+    assert resumed.tag == 0  # the last HEALTHY generation
+    # Forensics stay possible.
+    assert SnapshotterToFile.import_(snap.destination,
+                                     verify=False).tag == 666
+
+
+def test_db_backend_retention_retry_and_walk_back(tmp_path):
+    """SnapshotterToDB parity: retry_policy + snapshot.write
+    injection, row retention, checksum walk-back."""
+    db = os.path.join(str(tmp_path), "snaps.db")
+    wf = TinyWorkflow(Launcher())
+    snap = SnapshotterToDB(wf, database=db, prefix="tiny", keep=2,
+                           time_interval=0.0, compression="gz",
+                           injector=FaultInjector("snapshot.fail@1"))
+    snap.initialize()
+    for i in range(4):
+        wf.tag = i
+        snap.suffix = "g%d" % i
+        snap.export()
+    # The injected write fault was retried, not fatal.
+    assert resilience.stats.get("snapshot.retry") == 1
+    assert resilience.stats.get("snapshot.write") == 4
+    with sqlite3.connect(db) as conn:
+        rows = conn.execute("SELECT id FROM snapshots").fetchall()
+    assert len(rows) == 2  # retention pruned beyond keep=2
+    assert resilience.stats.get("snapshot.prune") == 2
+    assert SnapshotterToDB.import_(db, prefix="tiny").tag == 3
+    # Corrupt the newest row: import_ walks back to the previous.
+    with sqlite3.connect(db) as conn:
+        rid, blob = conn.execute(
+            "SELECT id, blob FROM snapshots "
+            "ORDER BY id DESC LIMIT 1").fetchone()
+        blob = bytes(blob)
+        mid = len(blob) // 2
+        bad = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:]
+        conn.execute("UPDATE snapshots SET blob = ? WHERE id = ?",
+                     (sqlite3.Binary(bad), rid))
+    assert SnapshotterToDB.import_(db, prefix="tiny").tag == 2
+    assert resilience.stats.get("snapshot.verify_fail") == 1
+
+
+def test_db_backend_skips_unhealthy_rows(tmp_path):
+    db = os.path.join(str(tmp_path), "snaps.db")
+    wf = TinyWorkflow(Launcher())
+    snap = SnapshotterToDB(wf, database=db, prefix="tiny",
+                           time_interval=0.0, compression="")
+    snap.initialize()
+    wf.tag = 1
+    snap.export()
+    wf.body.w.mem = numpy.array([numpy.inf], dtype=numpy.float32)
+    wf.tag = 2
+    snap.export()
+    assert SnapshotterToDB.import_(db, prefix="tiny").tag == 1
+    assert resilience.stats.get("snapshot.unhealthy") == 1
+    assert SnapshotterToDB.import_(db, prefix="tiny",
+                                   verify=False).tag == 2

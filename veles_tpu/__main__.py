@@ -247,8 +247,18 @@ class Main(Logger, CommandLineBase):
         if args.snapshot_compression is not None:
             root.common.snapshotter.compression = \
                 args.snapshot_compression
+        if args.snapshot_keep is not None:
+            root.common.snapshotter.keep = args.snapshot_keep
         if args.no_snapshots:
             root.common.snapshot_disabled = True
+        # Training health guardian knobs (guardian.init_parser):
+        # workflow builders read these back at construction.
+        if args.guardian_policy is not None:
+            root.common.guardian.policy = args.guardian_policy
+        if args.guardian_spike is not None:
+            root.common.guardian.spike_factor = args.guardian_spike
+        if args.guardian_window is not None:
+            root.common.guardian.window = args.guardian_window
         # Serving knobs for the in-workflow RESTfulAPI unit
         # (restful.serving_config_defaults reads these back).
         if args.serve_max_batch is not None:

@@ -30,6 +30,7 @@ cache, enabled in :func:`enable_compilation_cache`.
 
 import os
 
+from . import resilience
 from .config import root, get as config_get
 from .memory import Vector
 from .units import Unit
@@ -306,6 +307,66 @@ class StepCompiler(object):
         const_vecs = list(self.const_vectors)
         persist_ids = [str(id(v)) for v in self.persist_vectors]
         pname = self.param_name
+        # Health sentinel (guardian.py): evaluators expose a
+        # ``health_acc`` state row; the step accumulates per-class
+        # tick finiteness (isfinite(loss) & isfinite(grad_norm)) and
+        # the grad-norm scalar into it — fetched with the ordinary
+        # epoch accumulator, so detection costs no extra host syncs.
+        health_specs = []
+        for u in forward_units:
+            if "health_acc" in u.tstate:
+                cv = getattr(u, "minibatch_class_vec", None)
+                health_specs.append(
+                    (pname(u, "health_acc"),
+                     str(id(cv)) if cv is not None else None))
+        # Non-finite updates are dropped ON DEVICE (the gate below)
+        # unless the guardian's policy wants the poison to land so a
+        # rollback can be exercised (policy="rollback" sets this
+        # False at initialize; changing it later needs invalidate()).
+        device_skip = bool(getattr(self.workflow,
+                                   "health_device_skip", True))
+
+        def global_grad_norm(grads):
+            import jax.numpy as jnp
+            total = jnp.float32(0.0)
+            for g in grads.values():
+                total = total + jnp.sum(
+                    jnp.square(g.astype(jnp.float32)))
+            return jnp.sqrt(total)
+
+        def health_update(new_states, batch, gnorm, loss,
+                          valid=None):
+            """Adds this tick's health row — [nonfinite, gnorm sum,
+            gnorm max, ticks] at the minibatch's class — and returns
+            the tick's finite flag (a bool tracer).  ``valid`` gates
+            the whole row like the epoch accumulator gates its own:
+            padded block ticks (all-zero mask) must not count as
+            healthy ticks or dilute the mean grad norm."""
+            import jax.numpy as jnp
+            finite = jnp.isfinite(gnorm)
+            if loss is not None:
+                finite = jnp.logical_and(finite, jnp.isfinite(loss))
+            f32 = finite.astype(jnp.float32)
+            # A non-finite tick ALWAYS counts, even when the poison
+            # wrecked n_valid itself (NaN > 0 is False) — only
+            # padded-but-healthy ticks are gated out.
+            v = jnp.float32(1.0) if valid is None else \
+                jnp.logical_or(valid,
+                               jnp.logical_not(finite)).astype(
+                    jnp.float32)
+            safe_gnorm = jnp.where(finite, gnorm, 0.0) * v
+            for state_key, cvid in health_specs:
+                if cvid is not None and cvid in batch:
+                    cls = batch[cvid].astype(jnp.int32)
+                else:
+                    cls = jnp.int32(2)  # loaderless graph: TRAIN
+                acc = new_states[state_key]
+                acc = acc.at[cls].add(jnp.stack(
+                    [(1.0 - f32) * v, safe_gnorm,
+                     jnp.float32(0.0), v]))
+                acc = acc.at[cls, 2].max(safe_gnorm)
+                new_states[state_key] = acc
+            return finite
 
         def run_forward(params, states, batch, consts, key, training):
             bag = {}
@@ -397,13 +458,33 @@ class StepCompiler(object):
                 return loss, (metrics, new_states, outputs)
             grads, (metrics, new_states, outputs) = jax.grad(
                 loss_fn, has_aux=True)(params)
+            gate = None
+            if health_specs:
+                import jax.numpy as jnp
+                gnorm = global_grad_norm(grads)
+                metrics["grad_norm"] = gnorm
+                nv = metrics.get("n_valid")
+                finite = health_update(
+                    new_states, batch, gnorm, metrics.get("loss"),
+                    valid=None if nv is None else nv > 0)
+                metrics["step_finite"] = finite
+                if device_skip:
+                    gate = finite
             new_params, new_states = apply_updates(
-                params, grads, new_states, None)
+                params, grads, new_states, gate)
             return new_params, new_states, outputs, metrics
 
         def infer_step(params, states, batch, consts, key):
-            _, metrics, new_states, outputs = run_forward(
+            loss, metrics, new_states, outputs = run_forward(
                 params, states, batch, consts, key, False)
+            if health_specs and loss is not None:
+                # No gradients on eval ticks: the health row records
+                # loss finiteness with a zero grad-norm contribution.
+                import jax.numpy as jnp
+                nv = metrics.get("n_valid")
+                health_update(new_states, batch, jnp.float32(0.0),
+                              loss,
+                              valid=None if nv is None else nv > 0)
             return new_states, outputs, metrics
 
         def block_core(params, states, blocks, consts, key, training,
@@ -434,6 +515,13 @@ class StepCompiler(object):
                     loss_fn, has_aux=True)(p)
                 valid = metrics.get("n_valid", jnp.float32(1.0)) > 0
                 gate = jnp.logical_and(training > 0, valid)
+                if health_specs:
+                    gnorm = global_grad_norm(grads)
+                    finite = health_update(new_s, batch_t, gnorm,
+                                           metrics.get("loss"),
+                                           valid=valid)
+                    if device_skip:
+                        gate = jnp.logical_and(gate, finite)
                 new_p, new_s = apply_updates(p, grads, new_s, gate,
                                              hypers=hypers)
                 return (new_p, new_s), None
@@ -468,6 +556,13 @@ class StepCompiler(object):
         self._state_vecs = state_vecs
         self._fingerprint = self.fingerprint()
         self._compiled = True
+
+    def invalidate(self):
+        """Drops the compiled step so the next execute re-traces.
+        Needed when a Python-constant hyperparameter baked into the
+        trace changes without a shape change — e.g. the guardian's
+        LR backoff rewriting ``gd.learning_rate`` mid-run."""
+        self._compiled = None
 
     # -- execution ---------------------------------------------------------
 
@@ -627,10 +722,35 @@ class AcceleratedWorkflow(Workflow):
         if self._step_done_tick_ == self._tick_id_:
             return
         self._step_done_tick_ = self._tick_id_
+        try:
+            # step.nan chaos point (process-wide --chaos plan): the
+            # poison rides the REAL minibatch through the REAL step.
+            resilience.effective(None).check("step.nan")
+        except resilience.InjectedStepNaN:
+            self._poison_minibatch()
         from . import prng
         metrics = self.compiler.execute(
             key=prng.get().jax_key(), training=self.training)
         self.step_metrics = metrics
+
+    def _poison_minibatch(self):
+        """Feeds NaN into the current tick's minibatch mask (the
+        loader rewrites it on the next serve, so exactly one tick is
+        poisoned): loss and every gradient go NaN inside the fused
+        step — the bad-record scenario the health sentinel exists to
+        catch, exercised through production code."""
+        import numpy
+        loader = getattr(self, "loader", None)
+        mask = getattr(loader, "minibatch_mask", None)
+        if mask is None or not mask:
+            self.warning("step.nan fired but the workflow has no "
+                         "loader mask to poison — ignored")
+            return
+        mask.map_write()
+        mask.mem[...] = numpy.nan
+        self.warning("chaos: poisoned minibatch (epoch %s, class %s)",
+                     getattr(loader, "epoch_number", "?"),
+                     getattr(loader, "minibatch_class", "?"))
 
     def execute_block(self, blocks, training=None):
         """Dispatches a stacked block of ticks (see
@@ -638,6 +758,21 @@ class AcceleratedWorkflow(Workflow):
         if self._step_done_tick_ == self._tick_id_:
             return
         self._step_done_tick_ = self._tick_id_
+        try:
+            resilience.effective(None).check("step.nan")
+        except resilience.InjectedStepNaN:
+            # Block mode: the stacked arrays were already copied out
+            # of the loader vectors — poison the first tick in-place.
+            import numpy
+            loader = getattr(self, "loader", None)
+            mask = getattr(loader, "minibatch_mask", None)
+            mask_id = str(id(mask)) if mask is not None else None
+            if mask_id in blocks:
+                blocks[mask_id][0, ...] = numpy.nan
+                self.warning("chaos: poisoned first tick of block")
+            else:
+                self.warning("step.nan fired but the block carries "
+                             "no loader mask to poison — ignored")
         from . import prng
         if training is None:
             training = self.training
@@ -810,6 +945,15 @@ class AcceleratedWorkflow(Workflow):
             # (decision.epoch_number stays linked to the master
             # loader, which advanced at serve time.)
             d.finish_remote_class(cls, epoch)
+            # Master-side health check: worker metrics carried the
+            # sentinel's step_finite/grad_norm, the decision just
+            # folded them — the guardian reacts exactly as it would
+            # standalone (a rollback restores the MASTER's Vectors,
+            # which ship to workers with the next jobs).
+            guardian = getattr(self, "guardian", None)
+            if guardian is not None and \
+                    hasattr(guardian, "check_class"):
+                guardian.check_class(cls)
             if epoch_ended:
                 d.on_epoch_ended()
 

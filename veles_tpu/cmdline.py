@@ -161,6 +161,7 @@ class CommandLineBase(object):
 #: cmdline.py:61).
 CONTRIBUTING_MODULES = (
     "veles_tpu.client",
+    "veles_tpu.guardian",
     "veles_tpu.loader.base",
     "veles_tpu.restful",
     "veles_tpu.snapshotter",

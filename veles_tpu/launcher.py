@@ -391,6 +391,13 @@ class Launcher(Logger):
                     if v is not None:
                         metrics["%s_err" % name] = float(v)
             payload["metrics"] = metrics
+        # Training health (guardian.py): policy, event count, and the
+        # last NaN/spike event — operators see a recovered run WAS
+        # sick, not just that it survived.
+        guardian = getattr(wf, "guardian", None)
+        if guardian is not None and \
+                hasattr(guardian, "health_status"):
+            payload["health"] = guardian.health_status()
         if self.server is not None:
             payload["slaves"] = {
                 sid: {"state": desc.state,

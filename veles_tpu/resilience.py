@@ -115,6 +115,20 @@ class SnapshotWriteFault(InjectedFault, OSError):
     """A failed checkpoint write (disk full, NFS hiccup)."""
 
 
+class InjectedStepNaN(InjectedFault):
+    """A poisoned training tick: the consulting step executor (see
+    ``AcceleratedWorkflow.execute_step``) catches this and feeds NaN
+    into the minibatch, so the NaN flows through the REAL fused step
+    — loss, gradients, and the on-device health sentinel all see it
+    exactly the way a bad record would produce it."""
+
+
+class InjectedSnapshotCorruption(InjectedFault):
+    """Bit-rot on a just-written snapshot: the snapshotter catches
+    this and flips one byte of the blob AFTER the manifest was
+    computed, so checksum verification must reject it on resume."""
+
+
 # -- stats -----------------------------------------------------------------
 
 class ResilienceStats(object):
@@ -265,6 +279,8 @@ FAULTS = {
     "worker.kill": ("worker.job", WorkerKilled),
     "worker.hang": ("worker.job", WorkerHang),
     "snapshot.fail": ("snapshot.write", SnapshotWriteFault),
+    "snapshot.corrupt": ("snapshot.corrupt", InjectedSnapshotCorruption),
+    "step.nan": ("step.nan", InjectedStepNaN),
     "master.crash": ("master.crash", MasterCrash),
 }
 
@@ -445,11 +461,13 @@ def reset():
 
 def iter_snapshots(directory, prefix=None):
     """Yields snapshot paths named by ``*_current.lnk`` pointers in
-    ``directory``, newest pointer first.  ``prefix`` narrows the
-    search to one snapshot family.  A pointer's target must exist —
-    a dangling pointer (crash between snapshot unlink and pointer
-    rewrite is impossible with atomic writes, but operators delete
-    files) is skipped rather than crashing the resume."""
+    ``directory``, newest pointer first, then — per pointer family —
+    the family's OLDER generations (newest first).  ``prefix``
+    narrows the search to one snapshot family.  A dangling pointer
+    (operators delete files; a corrupt write leaves a rejected blob)
+    falls through to the family's surviving generations rather than
+    crashing the resume — the caller verifies each candidate and
+    walks on."""
     import glob
     import os
     if not directory or not os.path.isdir(directory):
@@ -465,22 +483,25 @@ def iter_snapshots(directory, prefix=None):
             return 0.0  # pruned between glob and sort: sorts last
 
     links.sort(key=_mtime, reverse=True)
+    from .snapshotter import SnapshotterToFile, iter_generations
     for link in links:
+        seen = set()
         try:
-            with open(link) as fin:
-                target = fin.read().strip()
-        except OSError:
-            continue
-        if not target:
-            continue
-        if not os.path.isfile(target):
-            # Legacy pointer holding a cwd-relative path: snapshot
-            # and pointer always share a directory, so retry there.
-            target = os.path.join(os.path.dirname(link),
-                                  os.path.basename(target))
-            if not os.path.isfile(target):
+            target = SnapshotterToFile.resolve(link)
+        except FileNotFoundError:
+            target = None  # dangling/empty: the walk takes over
+        if target is not None and os.path.isfile(target):
+            seen.add(os.path.abspath(target))
+            yield target
+        # Generation walk: older snapshots of the same family (kept
+        # by the retention policy) back a resume up past a corrupt,
+        # deleted, or unloadable newest snapshot.
+        family = os.path.basename(link)[:-len("_current.lnk")]
+        for path in iter_generations(os.path.dirname(link), family):
+            if os.path.abspath(path) in seen:
                 continue
-        yield target
+            seen.add(os.path.abspath(path))
+            yield path
 
 
 def latest_snapshot(directory, prefix=None):

@@ -1,4 +1,4 @@
-"""Snapshot comparison tool.
+"""Snapshot comparison + integrity verification tool.
 
 Capability parity with the reference script (reference:
 veles/scripts/compare_snapshots.py — diff two pickled workflow
@@ -7,19 +7,32 @@ walks their units, and reports per-tensor weight drift (L2 / max-abs
 difference), structural mismatches, and result-metric deltas.
 
 Run: ``python -m veles_tpu.scripts.compare_snapshots A B``.
+
+``--verify`` mode checks checkpoint INTEGRITY instead of drift: every
+snapshot generation in a directory (or one blob) is validated against
+its sidecar manifest (SHA-256 + size), ``_current.lnk`` pointers are
+resolved, and the exit status is non-zero when anything is corrupt,
+dangling, or unmanifested — so CI and operators can gate on
+checkpoint health from the command line::
+
+    python -m veles_tpu.scripts.compare_snapshots --verify snapshots/
 """
 
 import argparse
+import os
 
 import numpy
 
 
 def _load(spec):
+    # verify=False: compare mode is a read-only diagnostic — diffing
+    # a poisoned/corrupt snapshot against the last good one is the
+    # forensics workflow the verify errors point users at.
     if spec.startswith(("odbc://", "sqlite://", "db://")):
         from ..snapshotter import SnapshotterToDB
-        return SnapshotterToDB.import_(spec)
+        return SnapshotterToDB.import_(spec, verify=False)
     from ..snapshotter import SnapshotterToFile
-    return SnapshotterToFile.import_(spec)
+    return SnapshotterToFile.import_(spec, verify=False)
 
 
 def _tensors(workflow):
@@ -82,14 +95,99 @@ def compare(spec_a, spec_b):
     return report
 
 
+def verify(spec, prefix=None):
+    """Integrity report for a snapshot directory (every generation +
+    every ``_current.lnk`` pointer) or a single blob.  Returns
+    ``{"rows": [...], "ok": bool}`` — ``ok`` only when every row
+    verified; a blob without a manifest counts as a failure (it
+    cannot be proven good)."""
+    import glob
+    from ..snapshotter import (SnapshotterToFile, read_manifest,
+                               MANIFEST_SUFFIX)
+    rows = []
+    if os.path.isdir(spec):
+        blobs = sorted(
+            p for p in glob.glob(os.path.join(spec, "*.pickle*"))
+            if not p.endswith((MANIFEST_SUFFIX, ".part")))
+        for link in sorted(glob.glob(
+                os.path.join(spec, "*_current.lnk"))):
+            if prefix and not os.path.basename(link)[
+                    :-len("_current.lnk")].startswith(prefix):
+                continue  # --prefix scopes pointers too
+            try:
+                target = SnapshotterToFile.resolve(link)
+                rows.append({"path": link, "status": "ok",
+                             "target": target})
+            except FileNotFoundError as e:
+                rows.append({"path": link, "status": "dangling",
+                             "error": str(e)})
+    else:
+        blobs = [spec]
+    if prefix:
+        blobs = [p for p in blobs
+                 if os.path.basename(p).startswith(prefix)]
+    from ..snapshotter import SnapshotUnhealthyError
+    for path in blobs:
+        manifest = read_manifest(path)
+        if manifest is None:
+            rows.append({"path": path, "status": "no-manifest"})
+            continue
+        try:
+            SnapshotterToFile.verify(path)
+        except SnapshotUnhealthyError as e:
+            rows.append({"path": path, "status": "unhealthy",
+                         "error": str(e)})
+            continue
+        except Exception as e:
+            rows.append({"path": path, "status": "corrupt",
+                         "error": str(e)})
+            continue
+        rows.append({"path": path, "status": "ok",
+                     "sha256": manifest.get("sha256"),
+                     "epoch": manifest.get("epoch"),
+                     "validation_error":
+                         manifest.get("validation_error")})
+    return {"rows": rows,
+            "ok": bool(rows) and
+            all(r["status"] == "ok" for r in rows)}
+
+
+def verify_main(args):
+    report = verify(args.snapshot_a, prefix=args.prefix)
+    if args.json:
+        from ..json_encoders import dumps_json
+        print(dumps_json(report, indent=2))
+    else:
+        for row in report["rows"]:
+            print("%-12s %s%s" % (
+                row["status"], row["path"],
+                "  (%s)" % row["error"] if "error" in row else ""))
+        print("VERIFIED" if report["ok"] else "FAILED")
+    return 0 if report["ok"] else 1
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="veles_tpu.scripts.compare_snapshots")
     parser.add_argument("snapshot_a")
-    parser.add_argument("snapshot_b")
+    parser.add_argument("snapshot_b", nargs="?", default=None)
     parser.add_argument("--json", action="store_true",
                         help="machine-readable output")
+    parser.add_argument(
+        "--verify", action="store_true",
+        help="validate snapshot integrity (manifest checksums, "
+             "pointer resolution) of snapshot_a — a directory or a "
+             "single blob; exits non-zero on any failure")
+    parser.add_argument(
+        "--prefix", default=None,
+        help="with --verify on a directory: check only this "
+             "snapshot family")
     args = parser.parse_args(argv)
+    if args.verify:
+        return verify_main(args)
+    if args.snapshot_b is None:
+        parser.error("snapshot_b is required unless --verify is "
+                     "given")
     report = compare(args.snapshot_a, args.snapshot_b)
     if args.json:
         from ..json_encoders import dumps_json

@@ -162,17 +162,28 @@ class WebStatusServer(JsonHttpServer):
                 esc(json.dumps(resilience, sort_keys=True))
                 if isinstance(resilience, dict) and resilience
                 else "")
+            # Training health (guardian heartbeat section): flag a
+            # master that detected NaN/spike events prominently.
+            health = info.get("health")
+            health_row = ""
+            if isinstance(health, dict) and health:
+                style = ' style="color:#b00"' if \
+                    health.get("events") else ""
+                health_row = (
+                    "<tr><th>health</th><td%s>%s</td></tr>" %
+                    (style, esc(json.dumps(health, sort_keys=True,
+                                           default=str))))
             rows.append(
                 "<h2>%s <small>(%s)</small></h2>"
                 "<table><tr><th>mode</th><td>%s</td></tr>"
                 "<tr><th>epoch</th><td>%s</td></tr>"
                 "<tr><th>runtime</th><td>%.0f s</td></tr>"
-                "<tr><th>metrics</th><td>%s</td></tr>%s</table>" %
+                "<tr><th>metrics</th><td>%s</td></tr>%s%s</table>" %
                 (esc(info.get("workflow", "?")), esc(mid),
                  esc(info.get("mode", "?")), esc(info.get("epoch", "?")),
                  runtime,
                  esc(json.dumps(info.get("metrics", {}))),
-                 resilience_row) +
+                 health_row, resilience_row) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
                  "</th><th>jobs</th></tr>%s</table>" % wtable
                  if workers else "") +

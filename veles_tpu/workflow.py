@@ -363,6 +363,17 @@ class Workflow(Container):
         if events:
             self.info("Resilience events: %s", "; ".join(
                 "%s=%d" % (k, v) for k, v in sorted(events.items())))
+        # Training health: a recovered run must still LOOK sick in
+        # the exit report, or nobody audits what the guardian ate.
+        guardian = getattr(self, "guardian", None)
+        if guardian is not None and getattr(guardian, "events", None):
+            self.warning(
+                "Health events (%d, policy %s): %s",
+                len(guardian.events), guardian.policy, "; ".join(
+                    "epoch %s %s->%s" % (e.get("epoch"),
+                                         e.get("kind"),
+                                         e.get("action"))
+                    for e in guardian.events[-5:]))
 
     def gather_results(self):
         """Collects metrics from IResultProvider units into a dict

@@ -10,6 +10,8 @@ Host-side by design: metrics are tiny scalars fetched from the device
 once per tick (the only per-tick device→host sync in the fused design).
 """
 
+import numpy
+
 from ..mutable import Bool
 from ..result_provider import IResultProvider
 from ..units import Unit
@@ -72,6 +74,12 @@ class DecisionGD(DecisionBase, IResultProvider):
         self.epoch_n_valid = [0.0, 0.0, 0.0]
         self.epoch_loss = [0.0, 0.0, 0.0]
         self.epoch_metrics = [None, None, None]
+        # Health rows fetched with the epoch accumulator (guardian
+        # inputs): non-finite tick count and mean/max gradient norm
+        # per class-epoch.
+        self.epoch_nonfinite = [0.0, 0.0, 0.0]
+        self.epoch_grad_norm = [0.0, 0.0, 0.0]
+        self.epoch_grad_norm_max = [0.0, 0.0, 0.0]
         self.min_validation_err = 1.0e30
         self.min_validation_epoch = 0
         self.min_train_err = 1.0e30
@@ -96,6 +104,15 @@ class DecisionGD(DecisionBase, IResultProvider):
         ticks = max(float(row[3]), 1.0)
         self.epoch_loss[cls] = float(row[2]) / ticks
         self.evaluator.reset_epoch_acc(cls)
+        read_health = getattr(self.evaluator, "read_health_acc", None)
+        if read_health is None:  # evaluator from an older snapshot
+            return
+        health = read_health(cls)
+        self.epoch_nonfinite[cls] = float(health[0])
+        finite_ticks = max(float(health[3]) - float(health[0]), 1.0)
+        self.epoch_grad_norm[cls] = float(health[1]) / finite_ticks
+        self.epoch_grad_norm_max[cls] = float(health[2])
+        self.evaluator.reset_health_acc(cls)
 
     # -- remote (master-side) accumulation: per-tick metrics arrive in
     # worker updates instead of the on-device epoch accumulator
@@ -105,18 +122,33 @@ class DecisionGD(DecisionBase, IResultProvider):
     def init_unpickled(self):
         super(DecisionGD, self).init_unpickled()
         self._remote_acc_ = {}
+        # Decisions restored from a pre-guardian snapshot lack the
+        # health rows; default them so resumed runs keep working.
+        for attr in ("epoch_nonfinite", "epoch_grad_norm",
+                     "epoch_grad_norm_max"):
+            if not hasattr(self, attr):
+                setattr(self, attr, [0.0, 0.0, 0.0])
 
     def accumulate_remote(self, cls, metrics, epoch=None):
         """Buckets are keyed by (epoch, cls): with several workers,
         jobs from epoch N+1 start flowing before every epoch-N update
         has landed, and a flat per-class bucket would leak metrics
-        across the boundary (skewing per-epoch error accounting)."""
+        across the boundary (skewing per-epoch error accounting).
+        Worker steps ship the health sentinel's ``step_finite`` /
+        ``grad_norm`` metrics with the ordinary ones, so the
+        guardian's detection works identically in master mode."""
         acc = self._remote_acc_.setdefault(
-            (epoch, cls), [0.0, 0.0, 0.0, 0.0])
+            (epoch, cls), [0.0, 0.0, 0.0, 0.0, 0.0, 0.0])
+        finite = float(metrics.get("step_finite", 1.0))
+        gnorm = float(metrics.get("grad_norm", 0.0))
+        if not numpy.isfinite(finite):
+            finite = 0.0
         acc[0] += float(metrics.get("n_err", 0.0))
         acc[1] += float(metrics.get("n_valid", 0.0))
         acc[2] += float(metrics.get("loss", 0.0))
         acc[3] += 1.0
+        acc[4] += 1.0 - finite
+        acc[5] += gnorm if finite and numpy.isfinite(gnorm) else 0.0
 
     def finish_remote_class(self, cls, epoch=None):
         acc = self._remote_acc_.pop((epoch, cls), None)
@@ -125,6 +157,10 @@ class DecisionGD(DecisionBase, IResultProvider):
         self.epoch_n_err[cls] = acc[0]
         self.epoch_n_valid[cls] = acc[1]
         self.epoch_loss[cls] = acc[2] / max(acc[3], 1.0)
+        if len(acc) > 4:  # health columns (absent in old updates)
+            self.epoch_nonfinite[cls] = acc[4]
+            self.epoch_grad_norm[cls] = acc[5] / max(acc[3] - acc[4],
+                                                     1.0)
         self.on_last_minibatch(cls)
 
     def error_rate(self, cls):
@@ -132,6 +168,21 @@ class DecisionGD(DecisionBase, IResultProvider):
         return self.epoch_n_err[cls] / n if n else 0.0
 
     def on_last_minibatch(self, cls):
+        n = self.epoch_n_valid[cls]
+        if not n or not numpy.isfinite(n):
+            # No samples evaluated (empty class, dropped workers) or
+            # a poisoned epoch (NaN flowed into the accumulator):
+            # ``error_rate`` would read 0.0 / NaN, register a bogus
+            # "perfect" epoch, flip ``improved`` and trigger a junk
+            # snapshot — skip improvement/early-stop accounting
+            # entirely for this class-epoch.
+            if cls == VALID:
+                self.improved <<= False
+            self.info(
+                "epoch %d %s: no evaluable samples (n_valid=%s) — "
+                "improvement accounting skipped", self.epoch_number,
+                CLASS_NAME[cls], n)
+            return
         rate = self.error_rate(cls)
         self.epoch_metrics[cls] = rate
         self.info("epoch %d %s: err %.2f%% (%d/%d) loss %.4f",

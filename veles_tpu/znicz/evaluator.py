@@ -29,6 +29,14 @@ class EvaluatorBase(TracedUnit):
 
     ACC_ERR, ACC_VALID, ACC_LOSS, ACC_TICKS = range(4)
 
+    #: health_acc columns: per-class [non-finite ticks, grad-norm sum
+    #: (finite ticks only), grad-norm max, ticks observed].  Written
+    #: by the fused step (StepCompiler health sentinel), fetched by
+    #: the Decision with the ordinary epoch accumulator — no extra
+    #: host syncs.
+    HEALTH_NONFINITE, HEALTH_GNORM_SUM, HEALTH_GNORM_MAX, \
+        HEALTH_TICKS = range(4)
+
     def __init__(self, workflow, **kwargs):
         super(EvaluatorBase, self).__init__(workflow, **kwargs)
         self.view_group = "EVALUATOR"
@@ -42,6 +50,8 @@ class EvaluatorBase(TracedUnit):
         # summation in its OpenCL kernels, config.py:244-247).
         self.epoch_acc_c = Vector(numpy.zeros((3, 4),
                                               dtype=numpy.float32))
+        self.health_acc = Vector(numpy.zeros((3, 4),
+                                             dtype=numpy.float32))
         self.demand("input")
 
     @staticmethod
@@ -52,6 +62,12 @@ class EvaluatorBase(TracedUnit):
     @property
     def tstate(self):
         state = {"epoch_acc": self.epoch_acc}
+        health = getattr(self, "health_acc", None)
+        if health is None:  # evaluator from a pre-guardian snapshot
+            health = Vector(numpy.zeros((3, 4),
+                                        dtype=numpy.float32))
+            self.health_acc = health
+        state["health_acc"] = health
         if self._compensated():
             acc_c = getattr(self, "epoch_acc_c", None)
             if acc_c is None:  # evaluator from a pre-Kahan snapshot
@@ -97,6 +113,21 @@ class EvaluatorBase(TracedUnit):
         if acc_c:                                   # snapshots
             acc_c.map_write()
             acc_c.mem[cls] = 0.0
+
+    def read_health_acc(self, cls):
+        """Host fetch of one class's health row (rides the same
+        epoch-boundary sync as :meth:`read_epoch_acc`)."""
+        health = getattr(self, "health_acc", None)
+        if not health:  # pre-guardian snapshot, nothing accumulated
+            return numpy.zeros(4, dtype=numpy.float32)
+        health.map_read()
+        return numpy.array(health.mem[cls])
+
+    def reset_health_acc(self, cls):
+        health = getattr(self, "health_acc", None)
+        if health:
+            health.map_write()
+            health.mem[cls] = 0.0
 
 
 class EvaluatorSoftmax(EvaluatorBase):

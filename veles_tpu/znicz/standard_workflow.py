@@ -18,6 +18,7 @@ and the whole tick compiles into one jitted XLA step
 """
 
 from ..accelerated_units import AcceleratedWorkflow
+from ..guardian import HealthGuardian
 from ..loader.base import UserLoaderRegistry
 from ..plumbing import Repeater
 from .decision import DecisionGD
@@ -30,8 +31,8 @@ class StandardWorkflow(AcceleratedWorkflow):
 
     def __init__(self, workflow, layers=None, loader_name=None,
                  loader_cls=None, loader_config=None,
-                 decision_config=None, loss_function="softmax",
-                 **kwargs):
+                 decision_config=None, guardian_config=None,
+                 loss_function="softmax", **kwargs):
         super(StandardWorkflow, self).__init__(workflow, **kwargs)
         self.layer_configs = list(layers or [])
         self.loss_function = loss_function
@@ -50,9 +51,12 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.evaluator = self.link_evaluator()
         self.decision = self.link_decision(
             **dict(decision_config or {}))
+        self.guardian = self.link_guardian(
+            **dict(guardian_config or {}))
         self.gds = self.link_gds()
 
-        last_gd = self.gds[-1] if self.gds else self.decision
+        last_gd = self.gds[-1] if self.gds else \
+            (self.guardian or self.decision)
         self.repeater.link_from(last_gd)
         self.repeater.gate_block = self.decision.complete
         self.end_point.link_from(last_gd)
@@ -121,11 +125,31 @@ class StandardWorkflow(AcceleratedWorkflow):
             "epoch_ended", "epoch_number")
         return decision
 
+    def link_guardian(self, **guardian_config):
+        """Health guardian between decision and the GD chain (it
+        reads the metrics the decision just fetched, and a rollback
+        must happen before the next update applies).  Returns None
+        when the policy is "off" — pass ``guardian_config=
+        {"policy": "off"}`` (or set root.common.guardian.policy) to
+        train unguarded."""
+        from ..config import root as _root, get as _config_get
+        policy = guardian_config.get("policy", _config_get(
+            _root.common.guardian.policy, "skip"))
+        if policy == "off":
+            return None
+        guardian = HealthGuardian(self, decision=self.decision,
+                                  **guardian_config)
+        guardian.link_from(self.decision)
+        guardian.link_attrs(
+            self.loader, "minibatch_class", "last_minibatch",
+            "epoch_number")
+        return guardian
+
     def link_gds(self):
         """One trainer per trainable layer, output-first (znicz
         backprop order)."""
         gds = []
-        prev = self.decision
+        prev = self.guardian or self.decision
         for i in reversed(range(len(self.layer_configs))):
             layer = self.forwards[i]
             if not type(layer).HAS_PARAMS:
