@@ -173,6 +173,14 @@ class Main(Logger, CommandLineBase):
             out += ["--train-ratio", str(a.train_ratio)]
         if a.shuffle_limit is not None:
             out += ["--shuffle-limit", str(a.shuffle_limit)]
+        # Data-plane knobs travel to spawned workers so the handshake
+        # negotiation sees matching preferences on both sides.
+        if a.net_codec is not None:
+            out += ["--net-codec", a.net_codec]
+        if a.net_dtype is not None:
+            out += ["--net-dtype", a.net_dtype]
+        if a.net_legacy:
+            out.append("--net-legacy")
         return out + ["-m", "{master}"]
 
     def _launcher_kwargs(self):
@@ -205,6 +213,8 @@ class Main(Logger, CommandLineBase):
             if self.args.reconnect_delay is not None:
                 slave_kwargs["reconnect_delay"] = \
                     self.args.reconnect_delay
+            if self.args.net_legacy:
+                slave_kwargs["net_legacy"] = True
             if slave_kwargs:
                 kw["slave_kwargs"] = slave_kwargs
         if self.args.jax_coordinator or self.args.jax_num_processes \
@@ -273,6 +283,28 @@ class Main(Logger, CommandLineBase):
             root.common.serving.token = args.serve_token
         if args.serve_warmup:
             root.common.serving.warmup = True
+        # Distributed data-plane knobs (network_common.init_parser;
+        # docs/distributed.md) — read back by the handshake
+        # negotiation and the channels.
+        if args.net_codec is not None:
+            from .network_common import parse_codec_spec
+            name, level, threshold = parse_codec_spec(args.net_codec)
+            root.common.net.codec = name
+            if level is not None:
+                root.common.net.codec_level = level
+            if threshold is not None:
+                root.common.net.codec_threshold = threshold
+        if args.net_dtype is not None:
+            root.common.net.dtype = args.net_dtype
+        if args.job_ticks is not None:
+            if args.job_ticks < 1:
+                raise Bug("--job-ticks must be >= 1 (got %d)"
+                          % args.job_ticks)
+            root.common.net.job_ticks = args.job_ticks
+        if args.net_legacy:
+            root.common.net.mode = "legacy"
+        if args.net_require:
+            root.common.net.require = True
 
     def load(self, WorkflowClass, **kwargs):
         """``load`` closure passed to the module's run() hook

@@ -819,6 +819,9 @@ class AcceleratedWorkflow(Workflow):
                 "epoch_ended": bool(loader.epoch_ended),
                 "epoch_number": loader.epoch_number,
                 "epoch_key": epoch_key,
+                # Staleness observability: which weights version this
+                # job was generated from (delta-sync bookkeeping).
+                "weights_version": self.weights_version,
             }
             data["__job__"] = meta
             key = (epoch_key, meta["minibatch_class"])
@@ -834,28 +837,67 @@ class AcceleratedWorkflow(Workflow):
             self._job_meta_ = data["__job__"]
 
     def do_job(self, data, update, callback):
-        """Worker-side job execution: apply master data, run ONE fused
-        tick (the job's minibatch), return updated trainables +
-        metrics.  (The reference ran the whole gate-driven graph per
-        job, workflow.py:545; with the fused step that collapses to
-        one compiled call.)"""
+        """Worker-side job execution: apply master data, run the
+        job's ticks, return updated trainables + metrics.  (The
+        reference ran the whole gate-driven graph per job,
+        workflow.py:545; with the fused step that collapses to one
+        compiled call.)
+
+        Single-tick jobs run one fused step; multi-tick jobs
+        (``--job-ticks``) run ALL K minibatches as one scan-block
+        dispatch (StepCompiler block mode) — one weight sync, one
+        host→device upload, one dispatch per K ticks.  Block metrics
+        come from the on-device epoch accumulator (reset before,
+        read after — a single host sync per job)."""
         self.apply_data_from_master(data)
         if update is not None:
             self.apply_update_from_master(update)
         meta = getattr(self, "_job_meta_", None) or {}
         from .loader.base import TRAIN
-        training = meta.get("minibatch_class", TRAIN) == TRAIN
+        cls = meta.get("minibatch_class", TRAIN)
+        training = cls == TRAIN
+        loader = getattr(self, "loader", None)
+        take_block = getattr(loader, "take_staged_block", None)
+        block = take_block() if take_block is not None else None
         self.begin_tick()
         from . import prng
-        metrics = self.compiler.execute(key=prng.get().jax_key(),
-                                        training=training)
-        import jax
-        host_metrics = {k: float(jax.device_get(v))
-                        for k, v in metrics.items()}
+        if block is not None:
+            host_metrics = self._run_job_block(block, cls, training)
+        else:
+            metrics = self.compiler.execute(key=prng.get().jax_key(),
+                                            training=training)
+            import jax
+            host_metrics = {k: float(jax.device_get(v))
+                            for k, v in metrics.items()}
         result = self.generate_data_for_master()
         result["__metrics__"] = host_metrics
         result["__job__"] = meta
         callback(result)
+
+    def _run_job_block(self, block, cls, training):
+        """Dispatches a multi-tick job block and returns aggregate
+        metrics for the master's decision bucket ("ticks" marks them
+        as pre-summed over K minibatches)."""
+        ev = getattr(self, "evaluator", None)
+        if ev is not None and hasattr(ev, "reset_epoch_acc"):
+            ev.reset_epoch_acc(cls)
+            if hasattr(ev, "reset_health_acc"):
+                ev.reset_health_acc(cls)
+        self.execute_block(block, training)
+        metrics = {}
+        if ev is not None and hasattr(ev, "read_epoch_acc"):
+            row = ev.read_epoch_acc(cls)
+            metrics = {"n_err": float(row[0]),
+                       "n_valid": float(row[1]),
+                       "loss": float(row[2]),
+                       "ticks": float(row[3])}
+            ev.reset_epoch_acc(cls)
+            if hasattr(ev, "read_health_acc"):
+                health = ev.read_health_acc(cls)
+                metrics["nonfinite"] = float(health[0])
+                metrics["grad_norm_sum"] = float(health[1])
+                ev.reset_health_acc(cls)
+        return metrics
 
     def apply_data_from_slave(self, data, slave=None):
         """Master-side update application + decision bookkeeping."""
@@ -869,6 +911,13 @@ class AcceleratedWorkflow(Workflow):
                 # batch will be re-trained, so both its deltas and
                 # its metrics must be discarded entirely.
                 return
+            # Release the loader's pending-indices record for this
+            # job (replies carry no loader piece, so the unit sweep
+            # below never reaches it): one answered job = one FIFO
+            # entry; what remains is exactly what a drop requeues.
+            loader = getattr(self, "loader", None)
+            if loader is not None:
+                loader.apply_data_from_slave(None, slave)
         super(AcceleratedWorkflow, self).apply_data_from_slave(
             data, slave)
         try:

@@ -9,14 +9,25 @@ injection ``:302-307,438-442``, bounded reconnect attempts
 """
 
 import os
+import random
 import time
 
 from . import resilience
 from .logger import Logger
 from .network_common import (Channel, connect, machine_id,
                              normalize_secret)
-from .resilience import (HandshakeRejected, RetryPolicy, WorkerHang,
-                         WorkerKilled)
+from .resilience import (HandshakeRejected, ProtocolError,
+                         RetryPolicy, WorkerHang, WorkerKilled)
+
+#: Wire capabilities this worker advertises in its handshake
+#: (docs/distributed.md).  An old master simply ignores the key.
+WORKER_CAPS = {
+    "tensor": True,        # tensor-framed messages
+    "delta": True,         # delta weight sync (both directions)
+    "block": True,         # multi-tick jobs (fused scan-block)
+    "codecs": ("none", "gzip"),
+    "dtypes": ("fp32", "bf16"),
+}
 
 
 def init_parser(parser):
@@ -107,6 +118,22 @@ class Client(Logger):
         #: in-process chaos tests need.
         self.death_exits = kwargs.get("death_exits", False)
         self.poll_delay = kwargs.get("poll_delay", 0.05)
+        #: No-job poll schedule: jittered exponential backoff from
+        #: ``poll_delay`` (replacing a fixed sleep — an idle fleet
+        #: polling a paused master in lock-step is a self-inflicted
+        #: thundering herd), reset by the next real job.  Own rng:
+        #: idle-poll frequency is wall-clock-dependent, and drawing
+        #: from the shared seeded resilience stream would shift its
+        #: order and break chaos-replay determinism for everyone
+        #: else.
+        self.nojob_policy = kwargs.get("nojob_policy") or RetryPolicy(
+            max_attempts=1 << 30, base_delay=self.poll_delay,
+            factor=1.5, max_delay=2.0, rng=random.Random())
+        self._nojob_streak = 0
+        #: Legacy-protocol override (``--net-legacy``): the handshake
+        #: advertises no capabilities, so the session runs
+        #: pickle-compat regardless of the master's config.
+        self.net_legacy = kwargs.get("net_legacy", False)
         self.power = kwargs.get("power") or 1.0
         self.measure_power = kwargs.get("measure_power", False)
         #: Shared-secret HMAC key for frame authentication.  Same
@@ -178,6 +205,15 @@ class Client(Logger):
                 self.warning("simulating worker hang")
                 resilience.stats.incr("client.hang")
                 self._sleep_interruptible(e.seconds)
+            except ProtocolError as e:
+                # Desynchronized delta session (missing/mismatched
+                # base version): session-fatal, worker-recoverable —
+                # reconnect with a fresh id; the master requeues our
+                # in-flight work and rebases us with a full ship.
+                self.warning("protocol desync: %s — reconnecting "
+                             "with a fresh session", e)
+                resilience.stats.incr("client.proto_desync")
+                self.id = None
             except (OSError, ConnectionError) as e:
                 # Connection-level OR job-local I/O failure: the
                 # session is dead either way, but it must be VISIBLE —
@@ -205,6 +241,15 @@ class Client(Logger):
         deadline = time.time() + seconds
         while not self._stop and time.time() < deadline:
             time.sleep(0.05)
+
+    def _nojob_backoff(self):
+        """Jittered exponential no-job backoff on the shared
+        :class:`RetryPolicy` (base ``poll_delay``, capped at 2 s),
+        reset on the next real job — an idle fleet must not hammer a
+        paused/draining master in lock-step."""
+        self._sleep_interruptible(
+            self.nojob_policy.delay(self._nojob_streak))
+        self._nojob_streak += 1
 
     # -- phases ------------------------------------------------------------
 
@@ -248,11 +293,12 @@ class Client(Logger):
             if cmd == "update_ack":
                 continue
             if cmd == "no_job":
-                time.sleep(self.poll_delay)
+                self._nojob_backoff()
                 chan.send({"cmd": "job_request"})
                 continue
             if cmd != "job":
                 continue
+            self._nojob_streak = 0
             inj = self._injector_()
             inj.tick("job")
             inj.check("worker.job")
@@ -267,13 +313,16 @@ class Client(Logger):
         if self.measure_power:
             self.power = measure_computing_power()
             self._power_measured = time.time()
-        chan.send({
+        hello = {
             "cmd": "handshake",
             "checksum": self.workflow.checksum,
             "mid": machine_id(),
             "pid": os.getpid(),
             "power": self.power,
-        })
+        }
+        if not self.net_legacy:
+            hello["proto"] = dict(WORKER_CAPS)
+        chan.send(hello)
         reply = chan.recv()
         if reply is None:
             # With default keying (secret = workflow checksum) a
@@ -302,10 +351,21 @@ class Client(Logger):
                 "handshake_ack carried no session nonce — refusing "
                 "the session (peer cannot provide replay protection)")
         chan.rekey(nonce)
+        # Negotiated wire protocol: an old master sends no "proto"
+        # key — the session stays pickle-compat end to end.
+        proto = reply.get("proto") or {}
+        chan.set_proto(proto)
+        note = getattr(self.workflow, "note_net_proto", None)
+        if note is not None:
+            note(proto)
         initial = reply.get("initial")
         if initial:
             self.workflow.apply_data_from_master(initial)
-        self.info("joined as %s", self.id)
+        self.info("joined as %s%s", self.id,
+                  " (proto: delta=%s codec=%s ticks=%s)" % (
+                      proto.get("delta"), proto.get("codec"),
+                      proto.get("ticks")) if proto else
+                  " (pickle-compat)")
         return True
 
     def _job_cycle(self, chan):
@@ -319,10 +379,11 @@ class Client(Logger):
             if cmd == "bye":
                 return True
             if cmd == "no_job":
-                time.sleep(self.poll_delay)
+                self._nojob_backoff()
                 continue
             if cmd != "job":
                 continue
+            self._nojob_streak = 0
             inj = self._injector_()
             inj.tick("job")
             inj.check("worker.job")

@@ -163,6 +163,7 @@ CONTRIBUTING_MODULES = (
     "veles_tpu.client",
     "veles_tpu.guardian",
     "veles_tpu.loader.base",
+    "veles_tpu.network_common",
     "veles_tpu.restful",
     "veles_tpu.snapshotter",
 )

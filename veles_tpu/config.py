@@ -183,6 +183,26 @@ root.common.update({
         "time_interval": 15.0,
         "compression": "gz",
     },
+    "net": {
+        # Distributed data-plane knobs (docs/distributed.md).
+        # Wire payload codec: "gzip" or "none"; level/threshold feed
+        # the codec (frames below threshold bytes ship uncompressed).
+        "codec": "gzip",
+        "codec_level": 1,
+        "codec_threshold": 1 << 16,
+        # Delta dtype on the worker→master direction: "fp32" (exact)
+        # or "bf16" (2x smaller, lossy — breaks bit-reproducibility).
+        "dtype": "fp32",
+        # Minibatch ticks per distributed job (sync amortization).
+        "job_ticks": 1,
+        # "delta" (tensor framing + delta sync, negotiated down to
+        # pickle-compat for old peers) or "legacy" (force the old
+        # full-pickled-weights protocol).
+        "mode": "delta",
+        # Refuse pickle-compat fallback: old-format peers get a clean
+        # rejection instead of being served legacy frames.
+        "require": False,
+    },
     "web": {"host": "localhost", "port": 8090},
     "graphics": {"enabled": False},
     "trace": {"enabled": False, "dir": None},

@@ -331,6 +331,15 @@ class Launcher(Logger):
             self._heartbeat_stop.set()
             if self.server is not None:
                 self.server.stop()
+                # Per-worker job throughput next to the timing table
+                # (the comms half of the exit report; wire totals ride
+                # print_stats' resilience-events line as net.*).
+                slaves = getattr(self.server, "all_slaves", None)
+                if slaves:
+                    self.info("Worker throughput: %s", "; ".join(
+                        "%s=%d jobs (%.2f/s)" % (
+                            sid, desc.jobs_done, desc.jobs_per_second)
+                        for sid, desc in sorted(slaves.items())))
             self.workflow.print_stats()
 
     def on_workflow_finished(self):
@@ -402,12 +411,25 @@ class Launcher(Logger):
             payload["slaves"] = {
                 sid: {"state": desc.state,
                       "jobs_done": desc.jobs_done,
+                      "jobs_per_s": round(desc.jobs_per_second, 2),
                       "power": desc.power,
                       "blacklisted": desc.blacklisted}
                 for sid, desc in self.server.slaves.items()}
+        # One snapshot feeds both rows — two would disagree (counters
+        # advance between locked copies) within a single beat.
+        events = resilience.stats.snapshot()
+        # Comms observability (docs/distributed.md): wire volume and
+        # data-plane timing totals so operators see when the wire —
+        # not the chip — bounds scale-out.
+        net = {k: v for k, v in events.items()
+               if k.startswith("net.")}
+        if net:
+            payload["comms"] = net
         # Resilience events (retries, drops, blacklists, crashes,
         # resumes): operators see degradation, not just survive it.
-        events = resilience.stats.snapshot()
+        # net.* already rides the comms row — don't ship it twice.
+        events = {k: v for k, v in events.items()
+                  if not k.startswith("net.")}
         if events:
             payload["resilience"] = events
         # Dashboard depth (reference: web_status.py:113-243 shows the

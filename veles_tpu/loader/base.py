@@ -117,7 +117,16 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
 
     def init_unpickled(self):
         super(Loader, self).init_unpickled()
+        # slave -> list of in-flight JOB entries (oldest first), each
+        # a list of (indices, class) ticks.  A list, not a single
+        # slot: pipelined workers hold several jobs in flight and
+        # multi-tick jobs carry several minibatches — a drop must
+        # requeue every one of them.
         self._pending_indices_ = {}
+        # Worker-side staged multi-tick block (apply_data_from_master
+        # of a "block" piece; consumed by the workflow's block
+        # dispatch).
+        self._staged_block_ = None
         # Minibatches served but possibly not yet committed by the
         # step — elastic recovery (parallel.rebuild_mesh) requeues
         # them.  Single-tick serves hold one entry; a block serve
@@ -373,8 +382,8 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         else:
             indices = self._next_fresh_indices()
         if slave_id is not None:
-            self._pending_indices_[slave_id] = (
-                indices, self.minibatch_class)
+            self._pending_indices_.setdefault(slave_id, []).append(
+                [(indices, self.minibatch_class)])
         count = len(indices)
         mask = numpy.zeros(self.max_minibatch_size, dtype=numpy.float32)
         mask[:count] = 1.0
@@ -418,61 +427,113 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
             self.global_offset = 0
             self.shuffle()
 
-    def serve_block(self, max_ticks):
-        """Serves up to ``max_ticks`` consecutive minibatches of the
-        SAME sample class (stopping at class boundaries so epoch flags
-        stay truthful).  Returns {vector_id: (K, ...) array} with K =
-        ticks actually served — NOT padded: jit specializes the block
-        program per distinct K (a handful per run: the full block, the
-        train remainder, the validation remainder), which beats
-        burning a full block of conv compute on all-zero masks (a
-        256-sample validation pass used to cost as much as a
-        ticks_per_dispatch×batch training block)."""
-        idxs, masks = [], []
+    def _walk_block(self, max_ticks):
+        """The one block walk: serves up to ``max_ticks`` consecutive
+        minibatches of the SAME sample class (stopping at class
+        boundaries so epoch flags stay truthful; failed-batch retries
+        are served singly — they may belong to a different class than
+        the current walk).  Returns ``(idxs, masks, entries, cls)``:
+        padded per-tick index/mask arrays, the trimmed
+        ``[(indices, class), ...]`` in-flight entries, and the block's
+        class.  Both the local scan-block dispatch
+        (:meth:`serve_block`) and distributed multi-tick jobs
+        (:meth:`generate_data_for_slave`) wrap this walk — the break
+        conditions must never diverge between them."""
+        idxs, masks, entries = [], [], []
         cls = None
         for _ in range(max_ticks):
-            if self.failed_minibatches:
-                # Failed-batch retries are served singly (they may
-                # belong to a different class than the current walk).
-                if idxs:
-                    break
+            if self.failed_minibatches and idxs:
+                break
             next_off = self.global_offset \
                 if self.global_offset < self.total_samples else 0
             next_cls = self.class_of_offset(next_off)
             if cls is not None and next_cls != cls:
                 break
-            self.serve_next_minibatch()
+            served = self.serve_next_minibatch()
             cls = self.minibatch_class
+            entries.append((numpy.array(served, dtype=numpy.int32),
+                            int(cls)))
             idxs.append(self.minibatch_indices.mem.copy())
             masks.append(self.minibatch_mask.mem.copy())
             if self.last_minibatch or self.failed_minibatches:
                 break
-        served = len(idxs)
-        cls_arr = numpy.full(served, self.minibatch_class,
-                             dtype=numpy.int32)
+        return idxs, masks, entries, cls
+
+    def serve_block(self, max_ticks):
+        """Serves up to ``max_ticks`` consecutive minibatches of the
+        SAME sample class.  Returns {vector_id: (K, ...) array} with
+        K = ticks actually served — NOT padded: jit specializes the
+        block program per distinct K (a handful per run: the full
+        block, the train remainder, the validation remainder), which
+        beats burning a full block of conv compute on all-zero masks
+        (a 256-sample validation pass used to cost as much as a
+        ticks_per_dispatch×batch training block)."""
+        idxs, masks, entries, cls = self._walk_block(max_ticks)
         # The WHOLE block is in flight until its one dispatch commits
         # (per-tick serves above each overwrote the record).
-        self._in_flight_ = [
-            (idx[:int(mask.sum())].astype(numpy.int32),
-             int(c))
-            for idx, mask, c in zip(idxs, masks, cls_arr)]
+        self._in_flight_ = entries
         return {
             str(id(self.minibatch_indices)): numpy.stack(idxs),
             str(id(self.minibatch_mask)): numpy.stack(masks),
-            str(id(self.minibatch_class_vec)): cls_arr,
+            str(id(self.minibatch_class_vec)): numpy.full(
+                len(idxs), cls, dtype=numpy.int32),
         }
 
     # -- distributed contract ----------------------------------------------
 
     def generate_data_for_slave(self, slave=None):
         """The coordinator ships only indices (reference:
-        base.py:629-661)."""
-        indices = self.serve_next_minibatch(slave_id=slave)
-        return {"indices": indices,
-                "minibatch_class": self.minibatch_class,
-                "epoch_number": self.epoch_number}
+        base.py:629-661).  With a negotiated multi-tick job size
+        (``--job-ticks``), one job carries up to K same-class
+        minibatches — the worker runs them as one fused scan-block
+        dispatch, amortizing one weight sync over K ticks.  Blocks
+        stop at class boundaries (and at failed-minibatch retries,
+        which are served singly), so every tick of a job shares one
+        (epoch, class) accounting bucket — a job never straddles an
+        epoch or class edge."""
+        get = getattr(self.workflow, "slave_protocol", None)
+        ticks = int((get(slave) if get is not None else {})
+                    .get("ticks", 1) or 1)
+        if ticks <= 1:
+            indices = self.serve_next_minibatch(slave_id=slave)
+            return {"indices": indices,
+                    "minibatch_class": self.minibatch_class,
+                    "epoch_number": self.epoch_number}
+        epoch = self.epoch_number
+        idxs, masks, entries, cls = self._walk_block(ticks)
+        if slave is not None:
+            self._pending_indices_.setdefault(slave, []).append(
+                entries)
+        return {"block": {
+                    "indices": numpy.stack(idxs),
+                    "mask": numpy.stack(masks),
+                    "classes": numpy.full(len(idxs), cls,
+                                          dtype=numpy.int32)},
+                "minibatch_class": cls,
+                "epoch_number": epoch}
 
     def apply_data_from_master(self, data):
+        if "block" in data:
+            blk = data["block"]
+            indices = numpy.asarray(blk["indices"],
+                                    dtype=numpy.int32)
+            mask = numpy.asarray(blk["mask"], dtype=numpy.float32)
+            classes = numpy.asarray(blk["classes"],
+                                    dtype=numpy.int32)
+            self._staged_block_ = {
+                str(id(self.minibatch_indices)): indices,
+                str(id(self.minibatch_mask)): mask,
+                str(id(self.minibatch_class_vec)): classes,
+            }
+            # Single-tick vectors mirror the first tick so shape
+            # introspection and eager paths stay coherent.
+            self.minibatch_indices.mem = indices[0].copy()
+            self.minibatch_mask.mem = mask[0].copy()
+            self.minibatch_size = int(mask[0].sum())
+            self.minibatch_class = int(classes[0])
+            self.epoch_number = data["epoch_number"]
+            return
+        self._staged_block_ = None
         indices = numpy.asarray(data["indices"], dtype=numpy.int32)
         count = len(indices)
         padded = numpy.zeros(self.max_minibatch_size, dtype=numpy.int32)
@@ -485,21 +546,34 @@ class Loader(Unit, metaclass=UserLoaderRegistry):
         self.minibatch_class = data["minibatch_class"]
         self.epoch_number = data["epoch_number"]
 
+    def take_staged_block(self):
+        """Worker side: the job's staged multi-tick block ({vector id
+        → (K, ...) array}) or None; consumed once per job."""
+        block = self._staged_block_
+        self._staged_block_ = None
+        return block
+
     def apply_data_from_slave(self, data, slave=None):
-        self._pending_indices_.pop(slave, None)
+        jobs = self._pending_indices_.get(slave)
+        if jobs:
+            jobs.pop(0)  # oldest job answered (serve order = FIFO)
+            if not jobs:
+                self._pending_indices_.pop(slave, None)
 
     def drop_slave(self, slave=None):
-        """Requeues the dropped worker's in-flight minibatch with its
-        class (reference: base.py:677-685)."""
-        pending = self._pending_indices_.pop(slave, None)
-        if pending is not None:
-            self.failed_minibatches.append(pending)
+        """Requeues every tick of every in-flight job of the dropped
+        worker with its class (reference: base.py:677-685)."""
+        for entry in self._pending_indices_.pop(slave, ()):
+            self.failed_minibatches.extend(entry)
 
     # -- pickling: pending work is requeued so nothing is lost -------------
 
     def __getstate__(self):
         state = super(Loader, self).__getstate__()
-        pending = list(self._pending_indices_.values())
+        pending = [tick
+                   for jobs in self._pending_indices_.values()
+                   for entry in jobs
+                   for tick in entry]
         state["failed_minibatches"] = (
             list(self.failed_minibatches) + pending)
         return state

@@ -6,12 +6,17 @@ role of veles/txzmq/connection.py): address parsing, machine identity,
 and length-prefixed pickle framing over plain TCP sockets.
 
 TPU-era scope note: BULK data (gradients/weights) moves over ICI/DCN
-via XLA collectives (see parallel/); this channel carries only control
-traffic — handshakes, minibatch indices, small state — so a simple
-framed-pickle protocol over TCP replaces the reference's
-Twisted+ZeroMQ stack (SURVEY §5 "Distributed communication backend").
-Payloads may optionally be gzip-compressed (the reference offered
-snappy/gzip/xz codecs, txzmq/connection.py:484-560).
+via XLA collectives (see parallel/); this channel also carries the
+elastic master–worker job protocol, whose weight/delta payloads are
+params-sized — so besides the legacy framed-pickle format there is a
+**tensor-framed** wire format (negotiated in the handshake,
+docs/distributed.md): ndarrays leave the pickle and ride as raw
+buffer frames (memoryview-based send, no intermediate pickle copy of
+the array bytes; bounded recv into one reusable buffer), with a
+selectable per-tensor payload codec (``none``/``gzip``, level and
+size threshold configurable via ``--net-codec``) and optional bf16
+delta encoding (``--net-dtype``).  The reference offered
+snappy/gzip/xz codecs (txzmq/connection.py:484-560).
 """
 
 import gzip
@@ -20,16 +25,25 @@ import hmac as hmac_mod
 import pickle
 import socket
 import struct
+import threading
+import time
 import uuid
 import zlib
 
 _HEADER = struct.Struct(">QB")  # payload length, flags
 _FLAG_GZIP = 1
+#: Tensor-framed body (see :func:`encode_tensor_parts`).  Never sent
+#: unless the peer negotiated the capability in its handshake.
+_FLAG_TENSOR = 2
 _DIGEST_SIZE = hashlib.sha256().digest_size
 
 #: Payloads above this size are compressed (control messages are tiny;
 #: index arrays for big blocks may not be).
 COMPRESS_THRESHOLD = 1 << 16
+
+#: Default gzip level for wire compression (overridable per channel
+#: through :class:`WireCodec` / ``--net-codec``).
+COMPRESS_LEVEL = 1
 
 #: Frame-size bounds.  The 8-byte length header is network-supplied:
 #: without a cap a corrupt/hostile header drives ``_recv_exact`` into
@@ -70,49 +84,333 @@ def normalize_secret(secret):
     return bytes(secret) or None
 
 
-def _mac_input(flags, payload, nonce, seq):
-    """The authenticated bytes: per-connection nonce + monotonic
-    sequence + flags + body.  The nonce kills cross-session replay,
-    the sequence kills in-session replay/reorder."""
-    seq_bytes = b"" if seq is None else struct.pack(">Q", seq)
-    return nonce + seq_bytes + bytes([flags]) + payload
+def _mac_parts(secret, flags, parts, nonce, seq):
+    """HMAC over a multi-part body without concatenating it (the
+    parts may be params-sized memoryviews).  The authenticated bytes:
+    per-connection nonce + monotonic sequence + flags + body — the
+    nonce kills cross-session replay, the sequence kills in-session
+    replay/reorder."""
+    h = hmac_mod.new(secret, digestmod=hashlib.sha256)
+    h.update(nonce)
+    if seq is not None:
+        h.update(struct.pack(">Q", seq))
+    h.update(bytes([flags]))
+    for p in parts:
+        h.update(p)
+    return h.digest()
 
 
-def send_message(sock, obj, secret=None, nonce=b"", seq=None):
-    """Frames and sends one pickled message (blocking).  With
-    ``secret``, an HMAC-SHA256 over nonce+seq+flags+body is prepended
-    so the peer can authenticate the frame BEFORE unpickling (pickle
-    from an unauthenticated peer is arbitrary code execution).
+class WireCodec(object):
+    """Per-channel payload codec: ``name`` ("none"/"gzip"), gzip
+    ``level``, and the size ``threshold`` below which a payload ships
+    uncompressed (compressing tiny control frames wastes CPU for
+    negative savings)."""
+
+    def __init__(self, name="gzip", level=None, threshold=None):
+        self.name = name or "none"
+        self.level = COMPRESS_LEVEL if level is None else int(level)
+        self.threshold = COMPRESS_THRESHOLD if threshold is None \
+            else int(threshold)
+
+    @classmethod
+    def from_config(cls):
+        """Codec from ``root.common.net`` (the --net-codec flag)."""
+        from .config import root, get as config_get
+        return cls(config_get(root.common.net.codec, "gzip"),
+                   config_get(root.common.net.codec_level, None),
+                   config_get(root.common.net.codec_threshold, None))
+
+    def pack(self, payload):
+        """Returns (compressed_bool, bytes-like)."""
+        if self.name == "gzip" and len(payload) >= self.threshold:
+            packed = gzip.compress(payload,
+                                   compresslevel=self.level)
+            if len(packed) < len(payload):
+                return True, packed
+        return False, payload
+
+    def __repr__(self):
+        return "WireCodec(%r, level=%d, threshold=%d)" % (
+            self.name, self.level, self.threshold)
+
+
+# -- bf16 wire encoding ----------------------------------------------------
+
+def encode_bf16(arr):
+    """float32 → bfloat16 wire halves (uint16) with round-to-nearest-
+    even, numpy-only (no ml_dtypes dependency).  Used for the optional
+    lossy delta encoding (``--net-dtype bf16``)."""
+    import numpy
+    bits = numpy.ascontiguousarray(arr, dtype=numpy.float32).view(
+        numpy.uint32)
+    # RNE: add 0x7FFF + lsb-of-result before truncating.
+    rounded = bits + 0x7FFF + ((bits >> 16) & 1)
+    # NaNs must stay NaN: truncation of a NaN mantissa can land on an
+    # all-zero mantissa (= infinity); force a quiet-NaN pattern.
+    nan = (bits & 0x7FFFFFFF) > 0x7F800000
+    out = (rounded >> 16).astype(numpy.uint16)
+    out[nan] = ((bits[nan] >> 16) | 0x0040).astype(numpy.uint16)
+    return out
+
+
+def decode_bf16(halves, shape=None):
+    """bfloat16 wire halves → float32 (exact expansion)."""
+    import numpy
+    bits = halves.astype(numpy.uint32) << 16
+    out = bits.view(numpy.float32)
+    return out.reshape(shape) if shape is not None else out
+
+
+# -- tensor framing --------------------------------------------------------
+
+#: Arrays below this size stay inside the pickle skeleton — framing a
+#: 12-byte array costs more header than it saves.
+_TENSOR_MIN_BYTES = 256
+
+
+class _TensorRef(object):
+    """Pickle-skeleton placeholder for an extracted ndarray."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i):
+        self.i = i
+
+    def __reduce__(self):
+        return (_TensorRef, (self.i,))
+
+
+def _extract_tensors(obj, tensors):
+    """Recursively replaces large ndarrays in dict/list/tuple trees
+    with :class:`_TensorRef` markers, appending the arrays (made
+    C-contiguous) to ``tensors``.  Returns the skeleton."""
+    import numpy
+    if isinstance(obj, numpy.ndarray) and obj.dtype != object and \
+            obj.nbytes >= _TENSOR_MIN_BYTES:
+        arr = numpy.ascontiguousarray(obj)
+        tensors.append(arr)
+        return _TensorRef(len(tensors) - 1)
+    if isinstance(obj, dict):
+        return {k: _extract_tensors(v, tensors)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        seq = [_extract_tensors(v, tensors) for v in obj]
+        return seq if isinstance(obj, list) else tuple(seq)
+    return obj
+
+
+def _restore_tensors(obj, tensors):
+    if isinstance(obj, _TensorRef):
+        return tensors[obj.i]
+    if isinstance(obj, dict):
+        return {k: _restore_tensors(v, tensors)
+                for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_restore_tensors(v, tensors) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_restore_tensors(v, tensors) for v in obj)
+    return obj
+
+
+def encode_tensor_parts(obj, codec=None):
+    """Builds the tensor-framed body for ``obj``: a list of bytes-like
+    parts ``[u32 header_len + header, blob, blob, ...]``.
+
+    The header pickles ``(skeleton, [(dtype, shape, nbytes,
+    compressed), ...])``; each blob is the raw (or per-tensor
+    gzipped) array buffer.  Raw blobs are ``memoryview``s over the
+    arrays themselves — the array bytes are never copied into an
+    intermediate pickle (the zero-copy contract)."""
+    parts, _ = _encode_tensor_parts_timed(obj, codec)
+    return parts
+
+
+def _encode_tensor_parts_timed(obj, codec):
+    """(parts, compress_seconds) — the compress share is returned so
+    :func:`encode_message` can report serialize time EXCLUSIVE of
+    compression (net.serialize_us + net.compress_us must sum to
+    reality, not double-count)."""
+    codec = codec or _NO_CODEC
+    tensors = []
+    skeleton = _extract_tensors(obj, tensors)
+    specs = []
+    blobs = []
+    t0 = time.perf_counter()
+    for arr in tensors:
+        view = memoryview(arr).cast("B")
+        compressed, blob = codec.pack(view)
+        specs.append((arr.dtype.str, arr.shape, len(blob),
+                      compressed))
+        blobs.append(blob)
+    compress_s = time.perf_counter() - t0
+    from . import resilience
+    resilience.stats.incr("net.compress_us", int(compress_s * 1e6))
+    header = pickle.dumps((skeleton, specs),
+                          protocol=pickle.HIGHEST_PROTOCOL)
+    # Cap the RAW (pre-compression) size: the receiver's per-tensor
+    # decompression budget is MAX_MESSAGE_SIZE, so a frame that only
+    # fits the wire compressed would read there as a dead peer — the
+    # misleading-diagnostic failure the sender-side check exists to
+    # prevent.
+    _check_outgoing_size(
+        4 + len(header) + sum(arr.nbytes for arr in tensors))
+    return ([struct.pack(">I", len(header)) + header] + blobs,
+            compress_s)
+
+
+def decode_tensor_parts(payload, loads=None, max_message=None):
+    """Parses a tensor-framed body (one contiguous buffer).  Returns
+    the object, or None on any malformation/bound violation (the
+    dead-peer contract of :func:`recv_message`).  Uncompressed
+    tensors are ``frombuffer`` views into ``payload`` — pass a
+    writable buffer (bytearray/memoryview) for writable arrays."""
+    import numpy
+    limit = max_message if max_message is not None else \
+        MAX_MESSAGE_SIZE
+    view = memoryview(payload)
+    if len(view) < 4:
+        return None
+    (header_len,) = struct.unpack(">I", bytes(view[:4]))
+    if header_len > len(view) - 4:
+        return None
+    try:
+        skeleton, specs = (loads or pickle.loads)(
+            bytes(view[4:4 + header_len]))
+    except Exception:
+        return None
+    offset = 4 + header_len
+    budget = limit
+    tensors = []
+    for dtype_str, shape, nbytes, compressed in specs:
+        if nbytes < 0 or offset + nbytes > len(view):
+            return None
+        blob = view[offset:offset + nbytes]
+        offset += nbytes
+        try:
+            dt = numpy.dtype(dtype_str)
+            if compressed:
+                raw = _bounded_gunzip(blob, budget)
+                if raw is None:
+                    return None
+                budget -= len(raw)
+                # bytes → writable buffer so downstream in-place
+                # mutation keeps working (compressed tensors only;
+                # raw ones alias the recv buffer).
+                arr = numpy.frombuffer(bytearray(raw), dtype=dt)
+            else:
+                arr = numpy.frombuffer(blob, dtype=dt)
+            tensors.append(arr.reshape(shape))
+        except (ValueError, TypeError):
+            return None
+    try:
+        return _restore_tensors(skeleton, tensors)
+    except (IndexError, AttributeError):
+        return None
+
+
+def _check_outgoing_size(raw_bytes):
+    """Bounds an outgoing message by its RAW serialized size against
+    both receiver caps (minus MAC headroom).  Failing HERE, loudly,
+    matters: an oversize frame at the receiver reads as a dead peer
+    (its cap guards against hostile headers), and 'worker reconnects
+    forever with a misleading handshake warning' is a far worse
+    diagnostic than an exception naming the knob.  Raw, not
+    compressed: the receiver's decompression budget is
+    MAX_MESSAGE_SIZE, so a frame that only fits the wire compressed
+    would still be dropped there."""
+    cap = min(MAX_FRAME_SIZE, MAX_MESSAGE_SIZE) - 4096
+    if raw_bytes > cap:
+        raise ValueError(
+            "outgoing message serializes to %d raw bytes, above the "
+            "network_common.MAX_FRAME_SIZE/MAX_MESSAGE_SIZE caps "
+            "(%d/%d); raise them on BOTH peers for genuinely huge "
+            "messages" % (raw_bytes, MAX_FRAME_SIZE,
+                          MAX_MESSAGE_SIZE))
+
+
+_NO_CODEC = WireCodec("none")
+#: Module-default codec for bare :func:`send_message` callers —
+#: matches the historical hardcoded gzip-1/64KiB behavior.
+_DEFAULT_CODEC = WireCodec("gzip")
+
+
+def encode_message(obj, codec=None, tensor=False):
+    """Serializes ``obj`` into ``(flags, parts)`` for
+    :func:`send_parts` — the EXPENSIVE half of a send (pickling,
+    tensor extraction, compression), deliberately separable from the
+    cheap socket half so callers can serialize outside locks (the
+    coordinator serializes jobs outside its workflow lock).
+
+    ``tensor=True`` produces the tensor-framed format (negotiated
+    capability); otherwise the legacy whole-pickle format with
+    optional whole-payload gzip via ``codec``."""
+    t0 = time.perf_counter()
+    if tensor:
+        parts, compress_s = _encode_tensor_parts_timed(obj, codec)
+        flags = _FLAG_TENSOR
+    else:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        # Raw-pickle bound (compression only shrinks the frame, so
+        # passing here guarantees the peer's bounded gunzip accepts
+        # it — see _check_outgoing_size).
+        _check_outgoing_size(len(payload))
+        flags = 0
+        tc = time.perf_counter()
+        compressed, payload = (codec or _DEFAULT_CODEC).pack(payload)
+        if compressed:
+            flags |= _FLAG_GZIP
+        compress_s = time.perf_counter() - tc
+        from . import resilience
+        resilience.stats.incr("net.compress_us",
+                              int(compress_s * 1e6))
+        parts = [payload]
+    from . import resilience
+    # Exclusive of the compress share (already on net.compress_us) —
+    # the comms timings must sum to reality, not double-count.
+    resilience.stats.incr(
+        "net.serialize_us",
+        int((time.perf_counter() - t0 - compress_s) * 1e6))
+    return flags, parts
+
+
+def send_parts(sock, flags, parts, secret=None, nonce=b"", seq=None):
+    """Sends one pre-encoded frame (the cheap half — MAC + syscalls).
+    With ``secret``, an HMAC-SHA256 over nonce+seq+flags+body is
+    prepended so the peer can authenticate the frame BEFORE
+    unpickling (pickle from an unauthenticated peer is arbitrary code
+    execution).
 
     Frames beyond :data:`MAX_FRAME_SIZE` fail HERE, loudly: the
     receiver would silently drop the peer (its cap guards against
     hostile headers), and 'worker reconnects forever with a
     misleading handshake warning' is a far worse diagnostic than an
     exception naming the knob."""
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    # Compression only shrinks the wire frame, so bounding the raw
-    # pickle against BOTH receiver caps here (minus MAC headroom)
-    # guarantees the peer accepts the frame.
-    cap = min(MAX_FRAME_SIZE, MAX_MESSAGE_SIZE) - 4096
-    if len(payload) > cap:
-        raise ValueError(
-            "outgoing message pickles to %d bytes, above the "
-            "network_common.MAX_FRAME_SIZE/MAX_MESSAGE_SIZE caps "
-            "(%d/%d); raise them on BOTH peers for genuinely huge "
-            "control messages" %
-            (len(payload), MAX_FRAME_SIZE, MAX_MESSAGE_SIZE))
-    flags = 0
-    if len(payload) >= COMPRESS_THRESHOLD:
-        packed = gzip.compress(payload, compresslevel=1)
-        if len(packed) < len(payload):
-            payload = packed
-            flags |= _FLAG_GZIP
+    total = sum(len(memoryview(p).cast("B")) for p in parts)
+    # Backstop for hand-built parts; encode_message already bounded
+    # the raw size (one formula, one error — see the helper).
+    _check_outgoing_size(total)
+    t0 = time.perf_counter()
     if secret is not None:
-        mac = hmac_mod.new(secret,
-                           _mac_input(flags, payload, nonce, seq),
-                           hashlib.sha256).digest()
-        payload = mac + payload
-    sock.sendall(_HEADER.pack(len(payload), flags) + payload)
+        mac = _mac_parts(secret, flags, parts, nonce, seq)
+        sock.sendall(_HEADER.pack(total + _DIGEST_SIZE, flags) + mac)
+        total += _DIGEST_SIZE
+    else:
+        sock.sendall(_HEADER.pack(total, flags))
+    for p in parts:
+        sock.sendall(p)
+    from . import resilience
+    resilience.stats.incr("net.bytes_sent", total + _HEADER.size)
+    resilience.stats.incr("net.frames_sent")
+    resilience.stats.incr(
+        "net.send_us", int((time.perf_counter() - t0) * 1e6))
+
+
+def send_message(sock, obj, secret=None, nonce=b"", seq=None,
+                 codec=None, tensor=False):
+    """Frames and sends one message (blocking) — convenience wrapper
+    over :func:`encode_message` + :func:`send_parts`."""
+    flags, parts = encode_message(obj, codec=codec, tensor=tensor)
+    send_parts(sock, flags, parts, secret, nonce=nonce, seq=seq)
 
 
 def recv_message(sock, secret=None, nonce=b"", seq=None, loads=None,
@@ -140,22 +438,27 @@ def recv_message(sock, secret=None, nonce=b"", seq=None, loads=None,
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
+    from . import resilience
+    resilience.stats.incr("net.bytes_recv", length + _HEADER.size)
+    resilience.stats.incr("net.frames_recv")
     if secret is not None:
         if len(payload) < _DIGEST_SIZE:
             return None
         mac, payload = (payload[:_DIGEST_SIZE],
                         payload[_DIGEST_SIZE:])
-        want = hmac_mod.new(secret,
-                            _mac_input(flags, payload, nonce, seq),
-                            hashlib.sha256).digest()
-        if not hmac_mod.compare_digest(mac, want):
+        want = _mac_parts(secret, flags, [payload], nonce, seq)
+        if not hmac_mod.compare_digest(bytes(mac), want):
             return None
+    max_msg = max_message if max_message is not None \
+        else MAX_MESSAGE_SIZE
+    if flags & _FLAG_TENSOR:
+        # Tensor-framed body (self-describing; flag is MAC-covered so
+        # a peer cannot downgrade/upgrade the format undetected).
+        return decode_tensor_parts(payload, loads=loads,
+                                   max_message=max_msg)
     if flags & _FLAG_GZIP:
-        payload = _bounded_gunzip(
-            payload, max_message if max_message is not None
-            else MAX_MESSAGE_SIZE)
+        payload = _bounded_gunzip(payload, max_msg)
         if payload is None:
-            from . import resilience
             resilience.stats.incr("net.oversize")
             return None
     return (loads or pickle.loads)(payload)
@@ -190,7 +493,7 @@ class Channel(object):
     ``handshake_ack`` and both sides then :meth:`rekey` — every later
     frame is MAC-bound to that session."""
 
-    def __init__(self, sock, secret=None, injector=None):
+    def __init__(self, sock, secret=None, injector=None, codec=None):
         self.sock = sock
         self.secret = normalize_secret(secret)
         self.nonce = b""
@@ -201,6 +504,11 @@ class Channel(object):
         #: process-wide one, so a ``--chaos`` plan reaches every
         #: channel without explicit wiring.
         self.injector = injector
+        #: Negotiated wire protocol (set by :meth:`set_proto` after
+        #: the handshake); empty = legacy pickle framing.
+        self.proto = {}
+        self.codec = codec or WireCodec.from_config()
+        self._send_lock = threading.Lock()
 
     def _injector(self):
         from . import resilience
@@ -209,12 +517,46 @@ class Channel(object):
     def rekey(self, nonce):
         self.nonce = nonce
 
-    def send(self, obj):
+    def set_proto(self, proto):
+        """Installs the handshake-negotiated protocol: tensor framing
+        on/off and the effective codec (both peers must agree — the
+        negotiation result rides the handshake_ack).  An EMPTY proto
+        (legacy pickle-compat session) keeps the channel's configured
+        codec: old peers decompress _FLAG_GZIP frames fine, and
+        dropping to codec 'none' would ship their params-sized
+        pickles uncompressed — a silent wire-volume regression on
+        exactly the compat path."""
+        self.proto = dict(proto or {})
+        if not self.proto:
+            return
+        self.codec = WireCodec(self.proto.get("codec", "none"),
+                               self.proto.get("codec_level"),
+                               self.proto.get("codec_threshold"))
+
+    @property
+    def tensor_mode(self):
+        return bool(self.proto.get("tensor"))
+
+    def encode(self, obj):
+        """The expensive half of :meth:`send` (serialize + compress),
+        safe to run outside any lock; pair with :meth:`send_parts`."""
+        return encode_message(obj, codec=self.codec,
+                              tensor=self.tensor_mode)
+
+    def send_parts(self, flags, parts):
+        """The socket half of :meth:`send`: MAC + sequence + sendall.
+        Serialized per channel — two threads interleaving parts of
+        different frames would corrupt the stream."""
         self._injector().check("net.send")
-        send_message(self.sock, obj, self.secret, nonce=self.nonce,
-                     seq=self.send_seq if self.secret else None)
-        if self.secret is not None:
-            self.send_seq += 1
+        with self._send_lock:
+            send_parts(self.sock, flags, parts, self.secret,
+                       nonce=self.nonce,
+                       seq=self.send_seq if self.secret else None)
+            if self.secret is not None:
+                self.send_seq += 1
+
+    def send(self, obj):
+        self.send_parts(*self.encode(obj))
 
     def recv(self):
         self._injector().check("net.recv")
@@ -232,16 +574,65 @@ class Channel(object):
 
 
 def _recv_exact(sock, n):
-    buf = b""
-    while len(buf) < n:
+    """Receives exactly ``n`` bytes into ONE preallocated writable
+    buffer (``recv_into`` — no per-chunk bytes objects, and tensor
+    frames can expose writable zero-copy array views over it).
+    Returns a memoryview, or None on close/error."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
         try:
-            chunk = sock.recv(n - len(buf))
+            r = sock.recv_into(view[got:], n - got)
         except (ConnectionResetError, OSError):
             return None
-        if not chunk:
+        if not r:
             return None
-        buf += chunk
-    return buf
+        got += r
+    return view
+
+
+def init_parser(parser):
+    """Data-plane flags, aggregated into the velescli parser
+    (docs/distributed.md)."""
+    parser.add_argument(
+        "--net-codec", default=None,
+        metavar="NAME[:LEVEL[:THRESHOLD]]",
+        help="wire payload codec: 'none' or 'gzip' with optional "
+             "compression level and byte threshold below which "
+             "frames ship uncompressed (default gzip:1:65536); "
+             "negotiated down to what the peer supports")
+    parser.add_argument(
+        "--net-dtype", default=None, choices=("fp32", "bf16"),
+        help="worker→master weight-delta wire dtype: fp32 (exact, "
+             "default) or bf16 (half the bytes; LOSSY — breaks "
+             "bit-reproducibility of distributed runs)")
+    parser.add_argument(
+        "--job-ticks", type=int, default=None, metavar="K",
+        help="minibatch ticks per distributed job (default 1): the "
+             "worker runs K ticks as one fused scan-block dispatch, "
+             "amortizing one weight sync over K minibatches")
+    parser.add_argument(
+        "--net-legacy", action="store_true",
+        help="force the legacy full-pickled-weights protocol "
+             "(disables delta sync and tensor framing)")
+    parser.add_argument(
+        "--net-require", action="store_true",
+        help="refuse pickle-compat fallback: workers without the "
+             "tensor-framing capability are rejected with an "
+             "actionable error instead of being served legacy frames")
+
+
+def parse_codec_spec(spec):
+    """"gzip:6:4096" → ("gzip", 6, 4096); level/threshold optional."""
+    parts = str(spec).split(":")
+    name = parts[0] or "none"
+    if name not in ("none", "gzip"):
+        raise ValueError(
+            "unknown net codec %r (known: none, gzip)" % name)
+    level = int(parts[1]) if len(parts) > 1 and parts[1] else None
+    threshold = int(parts[2]) if len(parts) > 2 and parts[2] else None
+    return name, level, threshold
 
 
 def connect(address, timeout=None, io_timeout=None):

@@ -73,6 +73,13 @@ class HandshakeRejected(ResilienceError):
     keeps saying no wastes minutes and buries the real diagnostic."""
 
 
+class ProtocolError(ResilienceError):
+    """The peer spoke a desynchronized wire dialect (delta against a
+    missing/mismatched base version, unknown trainable).  Session-
+    fatal but recoverable: the worker reconnects with a fresh id and
+    the master rebases it with a full weights ship."""
+
+
 class InjectedFault(ResilienceError):
     """Base for injector-raised faults; carries the rule that fired."""
 
@@ -220,21 +227,38 @@ class RetryPolicy(object):
     """
 
     def __init__(self, max_attempts=5, base_delay=0.2, factor=2.0,
-                 max_delay=30.0, jitter=0.25, deadline=None):
+                 max_delay=30.0, jitter=0.25, deadline=None,
+                 rng=None):
         self.max_attempts = int(max_attempts)
         self.base_delay = float(base_delay)
         self.factor = float(factor)
         self.max_delay = float(max_delay)
         self.jitter = float(jitter)
         self.deadline = deadline
+        #: Private jitter source (``random.Random``-like).  None uses
+        #: the shared seeded resilience stream.  Policies whose draw
+        #: RATE is wall-clock-dependent (the client's no-job idle
+        #: poll) MUST bring their own rng — their draws would shift
+        #: the shared stream's order and break chaos-replay
+        #: determinism for every other consumer.
+        self.rng = rng
 
     def delay(self, attempt):
-        d = min(self.base_delay * self.factor ** attempt,
-                self.max_delay)
+        # factor**attempt overflows float range for a large enough
+        # attempt (an hour-long no-job streak reaches ~1750) — once
+        # past max_delay the exact power is irrelevant anyway.
+        try:
+            grown = self.base_delay * self.factor ** attempt
+        except OverflowError:
+            grown = self.max_delay
+        d = min(grown, self.max_delay)
         if self.jitter:
-            from . import prng
-            d *= 1.0 + prng.get(PRNG_KEY).uniform(-self.jitter,
-                                                  self.jitter)
+            if self.rng is not None:
+                rng = self.rng
+            else:
+                from . import prng
+                rng = prng.get(PRNG_KEY)
+            d *= 1.0 + rng.uniform(-self.jitter, self.jitter)
             d *= 1.0 + self.jitter * (_process_phase() - 0.5)
         if self.deadline is not None:
             d = self.deadline.clamp(d)

@@ -30,11 +30,66 @@ import threading
 import time
 
 from . import resilience
+from .config import root, get as config_get
 from .distributable import SniffedLock
 from .logger import Logger
 from .network_common import (Channel, machine_id, normalize_secret,
                              parse_address)
 from .resilience import MasterCrash
+
+
+def negotiate_protocol(hello, cfg=None):
+    """Computes the effective wire protocol for one worker from its
+    handshake capabilities and this coordinator's ``root.common.net``
+    configuration.
+
+    Returns ``(proto, error)``: ``proto`` is the negotiated dict
+    ({} = legacy pickle-compat), ``error`` a rejection string when the
+    peer cannot be served at all (``--net-require`` against an
+    old-format peer).  Every capability degrades gracefully by
+    default — a new master serves an old worker in pickle-compat
+    mode, and vice versa an old master simply ignores the ``proto``
+    key in the hello."""
+    if cfg is None:
+        cfg = {
+            "mode": config_get(root.common.net.mode, "delta"),
+            "codec": config_get(root.common.net.codec, "gzip"),
+            "codec_level": config_get(root.common.net.codec_level, 1),
+            "codec_threshold": config_get(
+                root.common.net.codec_threshold, 1 << 16),
+            "dtype": config_get(root.common.net.dtype, "fp32"),
+            "job_ticks": config_get(root.common.net.job_ticks, 1),
+            "require": config_get(root.common.net.require, False),
+        }
+    theirs = hello.get("proto") or {}
+    if not theirs.get("tensor") or cfg.get("mode") == "legacy":
+        if cfg.get("require") and cfg.get("mode") != "legacy":
+            return None, (
+                "this coordinator requires the tensor-framed delta "
+                "wire protocol (--net-require) but the worker's "
+                "handshake advertises no such capability — upgrade "
+                "the worker to a tensor-framing build, or restart "
+                "the coordinator without --net-require to serve it "
+                "in pickle-compat mode")
+        return {}, None  # legacy pickle-compat session
+    codec = cfg.get("codec", "gzip")
+    if codec not in (theirs.get("codecs") or ("none",)):
+        codec = "none"
+    dtype = cfg.get("dtype", "fp32")
+    if dtype not in (theirs.get("dtypes") or ("fp32",)):
+        dtype = "fp32"
+    ticks = int(cfg.get("job_ticks") or 1)
+    if not theirs.get("block"):
+        ticks = 1
+    return {
+        "tensor": True,
+        "delta": bool(theirs.get("delta")),
+        "codec": codec,
+        "codec_level": cfg.get("codec_level"),
+        "codec_threshold": cfg.get("codec_threshold"),
+        "dtype": dtype,
+        "ticks": max(1, ticks),
+    }, None
 
 
 class SlaveDescription(object):
@@ -49,8 +104,22 @@ class SlaveDescription(object):
         self.jobs_done = 0
         self.job_times = []
         self.job_started = None
+        self.joined = time.time()
+        self.last_update = None
         self.blacklisted = False
         self.paused = False
+
+    @property
+    def jobs_per_second(self):
+        """Per-worker job throughput over WALL CLOCK (join to last
+        applied update), not inverse busy-time — idle gaps (no_job
+        backoff, a paused master) must drag the number down, or the
+        comms row reads healthy exactly when the operator is
+        diagnosing a starved worker."""
+        if not self.jobs_done or self.last_update is None:
+            return 0.0
+        span = self.last_update - self.joined
+        return self.jobs_done / span if span > 0 else 0.0
 
 
 class Server(Logger):
@@ -72,6 +141,15 @@ class Server(Logger):
         # and reports acquisitions stuck past DEADLOCK_TIME.
         self._lock = SniffedLock(name="master.workflow_lock")
         self._slaves = {}
+        #: Departed workers' final descriptors (jobs_done/jobs_per_
+        #: second), kept for the exit throughput report — EVERY
+        #: disconnect (graceful bye included) removes the live entry,
+        #: so without this the report would always be empty.  Bounded
+        #: (oldest evicted): every reconnect mints a fresh sid, so an
+        #: elastic master under worker churn would otherwise leak one
+        #: descriptor per departed session.
+        self._retired_slaves = {}
+        self._max_retired = int(kwargs.get("max_retired", 64))
         self._slave_seq = 0
         self._stop = threading.Event()
         self.on_stopped = kwargs.get("on_stopped")
@@ -182,6 +260,15 @@ class Server(Logger):
     def slaves(self):
         return dict(self._slaves)
 
+    @property
+    def all_slaves(self):
+        """Live AND departed workers (live wins on id collision) —
+        the exit throughput report runs after every worker has said
+        bye, when :attr:`slaves` is already empty."""
+        merged = dict(self._retired_slaves)
+        merged.update(self._slaves)
+        return merged
+
     def pause_slave(self, sid):
         if sid in self._slaves:
             self._slaves[sid].paused = True
@@ -282,6 +369,11 @@ class Server(Logger):
                            "error": "checksum mismatch",
                            "expected": ours})
                 return
+            proto, proto_error = negotiate_protocol(hello)
+            if proto_error:
+                chan.send({"cmd": "error", "error": proto_error})
+                resilience.stats.incr("server.proto_reject")
+                return
             with self._lock:
                 self._slave_seq += 1
                 sid = "%s/%d" % (hello.get("mid", machine_id()),
@@ -290,18 +382,30 @@ class Server(Logger):
                     sid, hello.get("mid"), hello.get("power", 1.0),
                     addr)
                 self._slaves[sid] = desc
+                note = getattr(self.workflow, "note_slave_protocol",
+                               None)
+                if note is not None:
+                    note(sid, proto)
                 initial = self.workflow.\
                     generate_initial_data_for_slave(sid)
             # Fresh session nonce: all post-handshake frames (both
             # directions) are MAC-bound to it + a sequence number, so
             # captured frames cannot be replayed into this or any
-            # other session (ADVICE r2).
+            # other session (ADVICE r2).  The ack itself still rides
+            # the legacy framing (the peer switches formats only
+            # after reading the negotiation result).
             nonce = os.urandom(16)
             chan.send({"cmd": "handshake_ack", "id": sid,
-                       "nonce": nonce, "initial": initial})
+                       "nonce": nonce, "initial": initial,
+                       "proto": proto})
             chan.rekey(nonce)
-            self.info("worker %s joined (power %.1f)", sid,
-                      desc.power)
+            chan.set_proto(proto)
+            self.info("worker %s joined (power %.1f%s)", sid,
+                      desc.power,
+                      ", proto: delta=%s codec=%s ticks=%s" % (
+                          proto.get("delta"), proto.get("codec"),
+                          proto.get("ticks")) if proto else
+                      ", pickle-compat")
             self._message_loop(chan, desc)
         except MasterCrash:
             self.crash()
@@ -364,7 +468,7 @@ class Server(Logger):
                 else:
                     desc.state = "WORK"
                     desc.job_started = time.time()
-                    chan.send({"cmd": "job", "data": job})
+                    self._send_job(chan, job)
             elif cmd == "update":
                 self._apply_update(desc, msg["data"])
                 chan.send({"cmd": "update_ack"})
@@ -380,8 +484,22 @@ class Server(Logger):
 
     # -- workflow bridging -------------------------------------------------
 
+    def _send_job(self, chan, job):
+        """Serializes AND sends one job — called with the workflow
+        lock NOT held.  The lock split matters: serializing a
+        params-sized job for a slow worker must never stall
+        ``_apply_update`` from the others (``_generate_job`` holds
+        the lock only for the bookkeeping + host-side array
+        snapshot)."""
+        chan.send_parts(*self._serialize_job(chan, job))
+
+    def _serialize_job(self, chan, job):
+        """The expensive half (pickle/framing/compression), exposed
+        as a seam so tests can pin that it runs outside the lock."""
+        return chan.encode({"cmd": "job", "data": job})
+
     def _generate_job(self, desc):
-        """Serializes one job under the workflow lock
+        """Generates one job under the workflow lock
         (reference: server.py:596-611 deferred generation).  The
         ``job`` chaos counter ticks per job actually GENERATED —
         never on no_job polls, whose count is wall-clock-dependent —
@@ -422,6 +540,7 @@ class Server(Logger):
             self.workflow.apply_data_from_slave(data, desc.id)
             desc.state = "WAIT"
             desc.jobs_done += 1
+            desc.last_update = time.time()
             if desc.job_started is not None:
                 desc.job_times.append(time.time() - desc.job_started)
                 desc.job_started = None
@@ -451,7 +570,11 @@ class Server(Logger):
         (reference: server.py:315-338), then optionally respawn the
         worker."""
         with self._lock:
-            self._slaves.pop(desc.id, None)
+            if self._slaves.pop(desc.id, None) is not None:
+                self._retired_slaves[desc.id] = desc
+                while len(self._retired_slaves) > self._max_retired:
+                    self._retired_slaves.pop(
+                        next(iter(self._retired_slaves)))
             if self._outstanding.pop(desc.id, None):
                 resilience.stats.incr("server.requeue")
             self.workflow.drop_slave(desc.id)

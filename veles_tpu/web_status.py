@@ -149,8 +149,11 @@ class WebStatusServer(JsonHttpServer):
         for mid, info in sorted(status.items()):
             workers = info.get("slaves", {})
             wtable = "".join(
-                "<tr><td>%s</td><td>%s</td><td>%s</td></tr>" %
-                (esc(sid), esc(w.get("state")), esc(w.get("jobs_done")))
+                "<tr><td>%s</td><td>%s</td><td>%s</td><td>%s</td>"
+                "</tr>" %
+                (esc(sid), esc(w.get("state")),
+                 esc(w.get("jobs_done")),
+                 esc(w.get("jobs_per_s", "")))
                 for sid, w in workers.items())
             try:
                 runtime = float(info.get("runtime", 0.0))
@@ -162,6 +165,13 @@ class WebStatusServer(JsonHttpServer):
                 esc(json.dumps(resilience, sort_keys=True))
                 if isinstance(resilience, dict) and resilience
                 else "")
+            # Comms row: wire bytes/frames and serialize/compress/
+            # send timing totals from the distributed data plane.
+            comms = info.get("comms")
+            comms_row = (
+                "<tr><th>comms</th><td>%s</td></tr>" %
+                esc(json.dumps(comms, sort_keys=True))
+                if isinstance(comms, dict) and comms else "")
             # Training health (guardian heartbeat section): flag a
             # master that detected NaN/spike events prominently.
             health = info.get("health")
@@ -178,15 +188,15 @@ class WebStatusServer(JsonHttpServer):
                 "<table><tr><th>mode</th><td>%s</td></tr>"
                 "<tr><th>epoch</th><td>%s</td></tr>"
                 "<tr><th>runtime</th><td>%.0f s</td></tr>"
-                "<tr><th>metrics</th><td>%s</td></tr>%s%s</table>" %
+                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s</table>" %
                 (esc(info.get("workflow", "?")), esc(mid),
                  esc(info.get("mode", "?")), esc(info.get("epoch", "?")),
                  runtime,
                  esc(json.dumps(info.get("metrics", {}))),
-                 health_row, resilience_row) +
+                 health_row, resilience_row, comms_row) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
-                 "</th><th>jobs</th></tr>%s</table>" % wtable
-                 if workers else "") +
+                 "</th><th>jobs</th><th>jobs/s</th></tr>%s</table>"
+                 % wtable if workers else "") +
                 self._render_graph(info.get("graph")) +
                 self._render_plots(info.get("plots")))
         return _PAGE.format(rows="\n".join(rows) or

@@ -55,6 +55,14 @@ class Workflow(Container):
         self._finished_ = threading.Event()
         self._queue_ = collections.deque()
         self._run_time_started_ = time.time()
+        # Wire-protocol state (transient — renegotiated per session):
+        # master side keys per-slave negotiated capabilities, worker
+        # side holds this session's negotiated protocol; both are
+        # consulted by units' distributed-contract methods
+        # (docs/distributed.md).
+        self._slave_proto_ = {}
+        self._net_proto_ = {}
+        self._weights_version_ = 0
 
     @property
     def mesh(self):
@@ -293,6 +301,11 @@ class Workflow(Container):
                 continue
             if data and unit.name in data:
                 unit.apply_data_from_slave(data[unit.name], slave)
+        if self.is_main:
+            # One version bump per applied worker update (delta-sync
+            # staleness bookkeeping; nested workflows defer to the
+            # main one so the counter is bumped exactly once).
+            self.bump_weights_version()
 
     def apply_data_from_master(self, data):
         for unit in self._units:
@@ -318,6 +331,54 @@ class Workflow(Container):
         for unit in self._units:
             if unit is not self:
                 unit.drop_slave(slave)
+        self._slave_proto_.pop(slave, None)
+
+    # -- wire-protocol negotiation state (docs/distributed.md) -------------
+
+    def note_slave_protocol(self, slave, proto):
+        """Master side: records the handshake-negotiated protocol for
+        one worker (delta sync on/off, job ticks, wire dtype) — units
+        consult :meth:`slave_protocol` when generating/applying that
+        worker's data."""
+        self._slave_proto_[slave] = dict(proto or {})
+
+    def slave_protocol(self, slave):
+        """The negotiated protocol dict for ``slave`` ({} = legacy
+        pickle-compat peer).  Nested workflows delegate to their
+        parent — the Server only notifies the main workflow."""
+        proto = self._slave_proto_.get(slave)
+        if proto is None and isinstance(self._workflow, Workflow):
+            return self._workflow.slave_protocol(slave)
+        return proto or {}
+
+    def note_net_proto(self, proto):
+        """Worker side: records this session's negotiated protocol
+        (set by the Client after its handshake)."""
+        self._net_proto_ = dict(proto or {})
+
+    @property
+    def net_proto(self):
+        """The worker session's negotiated protocol ({} = legacy)."""
+        if not self._net_proto_ and isinstance(self._workflow,
+                                               Workflow):
+            return self._workflow.net_proto
+        return self._net_proto_
+
+    @property
+    def weights_version(self):
+        """Monotonic master-side weights version: bumps once per
+        applied worker update; rides job metadata so staleness is
+        observable and delta bases are verifiable.  Nested workflows
+        read the main workflow's counter."""
+        if isinstance(self._workflow, Workflow):
+            return self._workflow.weights_version
+        return self._weights_version_
+
+    def bump_weights_version(self):
+        if isinstance(self._workflow, Workflow):
+            return self._workflow.bump_weights_version()
+        self._weights_version_ += 1
+        return self._weights_version_
 
     # -- introspection -----------------------------------------------------
 

@@ -136,19 +136,35 @@ class DecisionGD(DecisionBase, IResultProvider):
         across the boundary (skewing per-epoch error accounting).
         Worker steps ship the health sentinel's ``step_finite`` /
         ``grad_norm`` metrics with the ordinary ones, so the
-        guardian's detection works identically in master mode."""
+        guardian's detection works identically in master mode.
+
+        Multi-tick jobs (``--job-ticks``) arrive PRE-SUMMED over the
+        block — the worker folds K minibatches through its on-device
+        epoch accumulator and ships the aggregate with a ``ticks``
+        count (plus ``nonfinite``/``grad_norm_sum`` health sums), so
+        bucket totals stay identical to K single-tick jobs."""
         acc = self._remote_acc_.setdefault(
             (epoch, cls), [0.0, 0.0, 0.0, 0.0, 0.0, 0.0])
-        finite = float(metrics.get("step_finite", 1.0))
-        gnorm = float(metrics.get("grad_norm", 0.0))
-        if not numpy.isfinite(finite):
-            finite = 0.0
+        ticks = float(metrics.get("ticks", 1.0))
+        if not numpy.isfinite(ticks) or ticks <= 0.0:
+            ticks = 1.0
         acc[0] += float(metrics.get("n_err", 0.0))
         acc[1] += float(metrics.get("n_valid", 0.0))
         acc[2] += float(metrics.get("loss", 0.0))
-        acc[3] += 1.0
-        acc[4] += 1.0 - finite
-        acc[5] += gnorm if finite and numpy.isfinite(gnorm) else 0.0
+        acc[3] += ticks
+        if "nonfinite" in metrics:  # pre-aggregated block health
+            nonfinite = float(metrics["nonfinite"])
+            gsum = float(metrics.get("grad_norm_sum", 0.0))
+            acc[4] += nonfinite if numpy.isfinite(nonfinite) else ticks
+            acc[5] += gsum if numpy.isfinite(gsum) else 0.0
+        else:
+            finite = float(metrics.get("step_finite", 1.0))
+            gnorm = float(metrics.get("grad_norm", 0.0))
+            if not numpy.isfinite(finite):
+                finite = 0.0
+            acc[4] += 1.0 - finite
+            acc[5] += gnorm if finite and numpy.isfinite(gnorm) \
+                else 0.0
 
     def finish_remote_class(self, cls, epoch=None):
         acc = self._remote_acc_.pop((epoch, cls), None)

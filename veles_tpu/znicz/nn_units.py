@@ -115,11 +115,33 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
 
     # -- distributed contract (reference: znicz GD units shipped
     # weights in jobs and aggregated slave results centrally;
-    # workflow.py:518-535 is the core contract) ---------------------------
+    # workflow.py:518-535 is the core contract).
+    #
+    # Two wire dialects, negotiated per worker in the handshake
+    # (docs/distributed.md):
+    #
+    # * legacy (pickle-compat): full trainables both directions; the
+    #   master keeps a FIFO of shipped copies per worker and folds
+    #   updates as ``current + (theirs − shipped)``;
+    # * delta: the WORKER computes ``theirs − shipped`` locally and
+    #   returns only that, so the master's fold is a plain
+    #   ``current + delta`` (bit-identical to the legacy fold — the
+    #   worker subtracts the same fp32 values the master would have)
+    #   and the shipped-copy FIFO disappears.  Downstream, full
+    #   weights ship only at join/rebase; later jobs carry the
+    #   accumulated change since that worker's last sync as a
+    #   BITWISE XOR delta (exact reconstruction — an arithmetic
+    #   delta would drift the worker off the master's exact values),
+    #   leaving O(1) master bookkeeping per WORKER (the last synced
+    #   state) instead of one full copy per in-flight job.
+    # ----------------------------------------------------------------------
 
     def init_unpickled(self):
         super(ForwardBase, self).init_unpickled()
-        self._shipped_ = {}
+        self._shipped_ = {}          # legacy per-worker FIFO
+        self._synced_ = {}           # delta: slave -> (version, arrays)
+        self._base_ = None           # worker: last synced arrays
+        self._base_version_ = None
 
     def _trainable_arrays(self):
         import numpy
@@ -129,22 +151,104 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
             out[attr] = numpy.array(vec.mem)
         return out
 
+    def _slave_proto(self, slave):
+        get = getattr(self.workflow, "slave_protocol", None)
+        return get(slave) if get is not None else {}
+
+    def _net_proto(self):
+        return getattr(self.workflow, "net_proto", None) or {}
+
+    @staticmethod
+    def _as_bits(arr):
+        import numpy
+        return arr.view(numpy.dtype("u%d" % arr.dtype.itemsize))
+
     def generate_data_for_slave(self, slave=None):
-        """Ships current trainables; remembers what each worker got so
-        its update can be applied as a delta.  A FIFO per worker:
-        pipelined (async) workers hold several jobs in flight, and
-        replies come back in serve order on the one TCP stream — a
-        single slot would mis-base job N's delta and turn job N+1's
-        into an absolute overwrite."""
+        """Ships trainables (or the change since this worker's last
+        sync) — see the dialect note above."""
         if not self.trainables:
             return None
+        import numpy
         arrays = self._trainable_arrays()
-        self._shipped_.setdefault(slave, []).append(arrays)
-        return arrays
+        if not self._slave_proto(slave).get("delta"):
+            # Legacy peer: full copy + FIFO.  Pipelined (async)
+            # workers hold several jobs in flight and replies come
+            # back in serve order on the one TCP stream — a single
+            # slot would mis-base job N's fold.
+            self._shipped_.setdefault(slave, []).append(arrays)
+            return arrays
+        version = getattr(self.workflow, "weights_version", 0)
+        prev = self._synced_.get(slave)
+        self._synced_[slave] = (version, arrays)
+        if prev is None:
+            return {"F": arrays, "v": version}
+        base_version, base = prev
+        delta = {}
+        for attr, arr in arrays.items():
+            b = base.get(attr)
+            if b is None or b.shape != arr.shape or \
+                    b.dtype != arr.dtype:
+                # Reshaped/grown trainables (rare): rebase with a
+                # full ship rather than an undecodable delta.
+                return {"F": arrays, "v": version}
+            bits = numpy.bitwise_xor(self._as_bits(arr),
+                                     self._as_bits(b))
+            # Unchanged tensors collapse to a None marker — with one
+            # worker (or an idle interval) the whole delta vanishes.
+            delta[attr] = bits if bits.any() else None
+        return {"D": delta, "v": version, "bv": base_version}
 
     def apply_data_from_master(self, data):
         if not data:
             return
+        import numpy
+        from ..resilience import ProtocolError
+        if "F" in data:
+            self._base_ = {}
+            for attr, arr in data["F"].items():
+                vec = self.trainables.get(attr)
+                if vec is not None:
+                    vec.mem = arr
+                    # Own copy: the base must survive however the
+                    # wire buffer or vec.mem is reused later.
+                    self._base_[attr] = numpy.array(arr)
+            self._base_version_ = data.get("v")
+            return
+        if "D" in data:
+            if self._base_ is None:
+                raise ProtocolError(
+                    "weights delta received before any full sync — "
+                    "the session is desynchronized; reconnecting "
+                    "will trigger a full rebase")
+            if data.get("bv") != self._base_version_:
+                raise ProtocolError(
+                    "weights delta based on version %s but this "
+                    "worker is synced to %s — reconnecting will "
+                    "trigger a full rebase" %
+                    (data.get("bv"), self._base_version_))
+            for attr, bits in data["D"].items():
+                vec = self.trainables.get(attr)
+                base = self._base_.get(attr)
+                if vec is None or base is None:
+                    raise ProtocolError(
+                        "weights delta names unknown trainable %r"
+                        % attr)
+                # vec.mem always gets its OWN copy, like the "F"
+                # branch: the base must survive however vec.mem is
+                # reused (in-place mutation of an aliased trainable
+                # would corrupt the next delta's subtraction base
+                # silently — version tags still match).
+                if bits is None:  # unchanged since last sync
+                    vec.mem = numpy.array(base)
+                    continue
+                new = numpy.bitwise_xor(
+                    self._as_bits(base),
+                    bits.reshape(base.shape)).view(base.dtype)
+                self._base_[attr] = new
+                vec.mem = numpy.array(new)
+            self._base_version_ = data.get("v")
+            return
+        # Legacy master: plain attr → array dict, full overwrite.
         for attr, arr in data.items():
             vec = self.trainables.get(attr)
             if vec is not None:
@@ -153,13 +257,48 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
     def generate_data_for_master(self):
         if not self.trainables:
             return None
-        return self._trainable_arrays()
+        arrays = self._trainable_arrays()
+        proto = self._net_proto()
+        if not proto.get("delta") or self._base_ is None:
+            return arrays
+        from ..network_common import encode_bf16
+        bf16 = proto.get("dtype") == "bf16"
+        delta = {}
+        for attr, arr in arrays.items():
+            b = self._base_.get(attr)
+            if b is None or b.shape != arr.shape:
+                return arrays  # desynced trainable set: full rebase
+            d = arr - b
+            if not d.any():
+                # Untouched trainables (every validation/test job)
+                # collapse to a None marker, mirroring the
+                # master→worker direction — with codec=none a dense
+                # zero delta would ship full-weights-sized payloads.
+                delta[attr] = None
+                continue
+            if bf16 and d.dtype == "float32":
+                d = {"b16": encode_bf16(d)}
+            delta[attr] = d
+        return {"U": delta, "bv": self._base_version_}
 
     def apply_data_from_slave(self, data, slave=None):
         """Delta aggregation (delayed/async SGD): the worker trained
         from the version we shipped it; fold ITS update into OUR
-        current values as (theirs − shipped)."""
+        current values as (theirs − shipped).  In the delta dialect
+        the worker already did the subtraction — the fold reduces to
+        one add and the master needs no shipped copy."""
         if not data:
+            return
+        if "U" in data:
+            from ..network_common import decode_bf16
+            for attr, d in data["U"].items():
+                vec = self.trainables.get(attr)
+                if vec is None or d is None:  # None = unchanged
+                    continue
+                if isinstance(d, dict) and "b16" in d:
+                    d = decode_bf16(d["b16"])
+                vec.map_read()  # device copy (if any) is not newer
+                vec.mem = vec.mem + d.reshape(vec.mem.shape)
             return
         bases = self._shipped_.get(slave)
         base = bases.pop(0) if bases else None
@@ -177,6 +316,7 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
 
     def drop_slave(self, slave=None):
         self._shipped_.pop(slave, None)
+        self._synced_.pop(slave, None)
 
 
 class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
