@@ -24,7 +24,10 @@ LM sized to exercise the chip (12 pre-LN blocks, embed 2048, head dim
 through the same fused block step; reports tokens/s and MFU against
 the analytic 6·P + attention FLOP count.  ``--lm-toy`` keeps the
 round-4 GPT-small-ish geometry (8 blocks / embed 512 / seq 512) for
-cross-round continuity.
+cross-round continuity.  ``--attn-stages=fused,bf16,pallas`` (or
+``all``/``none``) toggles the attention fast-path stages for the
+per-stage A/B attribution protocol (docs/attention.md,
+BENCHNOTES r6); the chosen set rides the JSON line.
 
 ``python bench.py --streamed-jpeg`` decodes REAL JPEG files (a
 synthetic directory tree written once) through the streamed loader's
@@ -199,6 +202,48 @@ def build_mlp():
                        max_epochs=1000, loader_cls=SyntheticMnist)
     launcher.initialize()
     return launcher, wf
+
+
+#: The attention fast-path stages ``--attn-stages`` can toggle
+#: (docs/attention.md; each maps to one root.common.engine knob).
+ATTN_STAGES = ("fused", "bf16", "pallas")
+
+
+def parse_attn_stages(argv):
+    """``--attn-stages=fused,bf16,pallas`` → the stage set for the LM
+    bench A/B protocol (BENCHNOTES r6): "none" (or absent) is the
+    r5 baseline, "all" turns every stage on."""
+    stages = None
+    for arg in argv:
+        if arg.startswith("--attn-stages="):
+            stages = arg.split("=", 1)[1]
+    if stages is None or stages == "none":
+        return ()
+    if stages == "all":
+        return ATTN_STAGES
+    out = []
+    for s in stages.split(","):
+        s = s.strip()
+        if not s:
+            continue
+        if s not in ATTN_STAGES:
+            raise SystemExit(
+                "unknown attention stage %r — valid: %s, 'all', "
+                "'none'" % (s, ", ".join(ATTN_STAGES)))
+        out.append(s)
+    return tuple(out)
+
+
+def apply_attn_stages(stages):
+    """Sets the engine knobs for the chosen stages (the same knobs
+    the --attn-* CLI flags set for a real run; the fused_qkv knob is
+    read at unit CONSTRUCTION, so this must run before build_lm)."""
+    from veles_tpu.config import root
+    root.common.engine.fused_qkv = "fused" in stages
+    root.common.engine.attention_dtype = \
+        "bf16" if "bf16" in stages else "f32"
+    root.common.engine.attention_kernel = \
+        "auto" if "pallas" in stages else "xla"
 
 
 def build_lm(vocab=LM_VOCAB, seq=LM_SEQ, embed=LM_EMBED,
@@ -504,6 +549,12 @@ def main():
         return
     if "--lm" in sys.argv or "--lm-toy" in sys.argv:
         toy = "--lm-toy" in sys.argv
+        # A/B hook for the attention fast path (BENCHNOTES r6):
+        # --attn-stages=fused,bf16,pallas toggles each stage's engine
+        # knob before the workflow is built, and the stage set rides
+        # the JSON line so per-stage attribution is in the record.
+        stages = parse_attn_stages(sys.argv)
+        apply_attn_stages(stages)
         if toy:
             geom = dict(vocab=LM_TOY_VOCAB, seq=LM_TOY_SEQ,
                         embed=LM_TOY_EMBED, heads=LM_TOY_HEADS,
@@ -543,6 +594,7 @@ def main():
             "vs_baseline_meaning": "mfu_fraction_no_reference_lm",
             "model_tflops_per_sec": round(tflops, 1),
             "mfu_vs_v5e_bf16_peak": round(mfu, 4),
+            "attn_stages": list(stages),
         }))
         return
     if "--mlp" in sys.argv:

@@ -342,10 +342,17 @@ void layer_norm(const float *x, const float *g, const float *b,
  * by the caller and reused across the batch loop (the attention is
  * the native serving hot path). */
 struct AttnScratch {
-  std::vector<float> h, q, k, v, attn, scores;
+  std::vector<float> h, q, k, v, attn, scores, qkv;
   AttnScratch(int seq, int embed)
       : h((size_t)seq * embed), q(h.size()), k(h.size()),
         v(h.size()), attn(h.size()), scores((size_t)seq) {}
+  /* qkv (seq × 3·embed) is only needed for fused-wqkv artifacts —
+   * sized on first fused use so unfused models never pay the 3×
+   * allocation. */
+  float *qkv_buf(size_t n) {
+    if (qkv.size() < n) qkv.resize(n);
+    return qkv.data();
+  }
 };
 
 /* One sample's pre-LN attention with residual:
@@ -366,12 +373,33 @@ void transformer_attention(const UnitDesc &u, const float *x,
   for (int t = 0; t < seq; ++t)
     layer_norm(x + (size_t)t * embed, P("ln1_g"), P("ln1_b"),
                h.data() + (size_t)t * embed, embed);
-  matmul_bias(h.data(), P("wq"), P("bq"), q.data(), seq, embed,
-              embed);
-  matmul_bias(h.data(), P("wk"), P("bk"), k.data(), seq, embed,
-              embed);
-  matmul_bias(h.data(), P("wv"), P("bv"), v.data(), seq, embed,
-              embed);
+  if (u.params.count("wqkv")) {
+    /* Fused-QKV artifact (znicz/attention.fuse_qkv_arrays): one
+     * (E, 3E) matmul whose columns are head-major [q_h|k_h|v_h]
+     * blocks of D each; de-interleave into the per-head q/k/v
+     * buffers the attention loop below expects. */
+    float *qkvb = ws.qkv_buf((size_t)seq * 3 * embed);
+    matmul_bias(h.data(), P("wqkv"), P("bqkv"), qkvb, seq,
+                embed, 3 * embed);
+    float *dst[3] = {q.data(), k.data(), v.data()};
+    for (int t = 0; t < seq; ++t)
+      for (int head = 0; head < H; ++head)
+        for (int part = 0; part < 3; ++part) {
+          const float *src = qkvb +
+              (size_t)t * 3 * embed +
+              ((size_t)head * 3 + part) * D;
+          float *d = dst[part] + (size_t)t * embed +
+              (size_t)head * D;
+          for (int e = 0; e < D; ++e) d[e] = src[e];
+        }
+  } else {
+    matmul_bias(h.data(), P("wq"), P("bq"), q.data(), seq, embed,
+                embed);
+    matmul_bias(h.data(), P("wk"), P("bk"), k.data(), seq, embed,
+                embed);
+    matmul_bias(h.data(), P("wv"), P("bv"), v.data(), seq, embed,
+                embed);
+  }
   std::fill(attn.begin(), attn.end(), 0.0f);
   for (int head = 0; head < H; ++head) {
     const int off = head * D;
@@ -556,6 +584,23 @@ bool check_optional_bias(const UnitDesc &u, size_t want) {
   return true;
 }
 
+/* The attention projection comes in two layouts: the classic three
+ * (E, E) wq/wk/wv matrices, or the fused head-major (E, 3E) wqkv
+ * (znicz/attention.fuse_qkv_arrays) — the executor dispatches on
+ * wqkv's presence, so validation must too. */
+bool check_attention_proj(const UnitDesc &u, size_t E) {
+  if (u.params.count("wqkv"))
+    return checked_param(u, "wqkv", E * 3 * E) &&
+           checked_param(u, "bqkv", 3 * E);
+  const char *vecs[] = {"bq", "bk", "bv"};
+  for (const char *n : vecs)
+    if (!checked_param(u, n, E)) return false;
+  const char *mats[] = {"wq", "wk", "wv"};
+  for (const char *n : mats)
+    if (!checked_param(u, n, E * E)) return false;
+  return true;
+}
+
 bool infer_shapes(VtModel *m) {
   for (size_t i = 0; i < m->units.size(); ++i) {
     const UnitDesc &u = m->units[i];
@@ -686,13 +731,13 @@ bool infer_shapes(VtModel *m) {
       }
       const int hidden = (int)w1it->second.dims[1];
       const size_t E = (size_t)embed;
-      const char *vecs_e[] = {"ln1_g", "ln1_b", "bq", "bk", "bv",
-                              "bo", "ln2_g", "ln2_b", "b2"};
+      const char *vecs_e[] = {"ln1_g", "ln1_b", "bo", "ln2_g",
+                              "ln2_b", "b2"};
       for (const char *n : vecs_e)
         if (!checked_param(u, n, E)) return false;
-      const char *mats_ee[] = {"wq", "wk", "wv", "wo"};
-      for (const char *n : mats_ee)
-        if (!checked_param(u, n, E * E)) return false;
+      if (!check_attention_proj(u, E) ||
+          !checked_param(u, "wo", E * E))
+        return false;
       if (!checked_param(u, "b1", (size_t)hidden) ||
           !checked_param(u, "w2", (size_t)hidden * embed))
         return false;
@@ -716,13 +761,13 @@ bool infer_shapes(VtModel *m) {
       }
       const int hidden = (int)w1it->second.dims[2];
       const size_t E = (size_t)embed;
-      const char *vecs_e[] = {"ln1_g", "ln1_b", "bq", "bk", "bv",
-                              "bo", "ln2_g", "ln2_b"};
+      const char *vecs_e[] = {"ln1_g", "ln1_b", "bo", "ln2_g",
+                              "ln2_b"};
       for (const char *n : vecs_e)
         if (!checked_param(u, n, E)) return false;
-      const char *mats_ee[] = {"wq", "wk", "wv", "wo"};
-      for (const char *n : mats_ee)
-        if (!checked_param(u, n, E * E)) return false;
+      if (!check_attention_proj(u, E) ||
+          !checked_param(u, "wo", E * E))
+        return false;
       if (!checked_param(u, "router", E * nexp) ||
           !checked_param(u, "b1", (size_t)nexp * hidden) ||
           !checked_param(u, "w2",

@@ -126,6 +126,7 @@ def test_tinylm_sequence_parallel_training():
 
 @pytest.mark.parametrize("variant,kwargs,param,lead", [
     ("dense", {}, "wq", None),
+    ("fused", {"fused_qkv": True}, "wqkv", None),
     ("moe", {"n_experts": 4}, "w1", 4),
     ("pipelined", {"pipelined": True, "n_blocks": 4}, "w1", 4),
 ])

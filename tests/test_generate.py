@@ -76,6 +76,31 @@ def test_generation_is_deterministic_per_seed(lm_model):
     numpy.testing.assert_array_equal(g1, g2)
 
 
+def test_prompt_bucketing_reuses_one_compiled_fn(lm_model):
+    """Decode-serving compile policy: prompt lengths round up to a
+    power-of-two bucket, so two lengths in the same bucket share ONE
+    compiled program (exactly one compile-cache MISS) — and the
+    bucketed greedy decode is bit-identical to the exact-length
+    KV-cache program (the ``return_logits`` path)."""
+    model, _ = lm_model
+    rng = numpy.random.RandomState(1)
+    cache = model.compile_cache
+    p5 = rng.randint(0, 16, (2, 5)).astype(numpy.int32)
+    p7 = rng.randint(0, 16, (2, 7)).astype(numpy.int32)
+    base_miss = cache.misses
+    full5 = model.generate(p5, 4)
+    miss_first = cache.misses
+    assert miss_first == base_miss + 1  # the bucket's one compile
+    full7 = model.generate(p7, 4)
+    assert cache.misses == miss_first  # same bucket → pure cache hit
+    # Greedy parity gate: the padded-bucket decode must match the
+    # exact-length program token for token.
+    exact5, _ = model.generate(p5, 4, return_logits=True)
+    exact7, _ = model.generate(p7, 4, return_logits=True)
+    numpy.testing.assert_array_equal(full5, exact5)
+    numpy.testing.assert_array_equal(full7, exact7)
+
+
 def test_generate_rejects_over_long_request(lm_model):
     model, _ = lm_model
     prompt = numpy.zeros((1, 30), numpy.int32)

@@ -113,7 +113,11 @@ class Main(Logger, CommandLineBase):
 
     def parse(self):
         parser = init_argparser(prog="veles_tpu")
-        self.args = parser.parse_args(self.argv)
+        # parse_intermixed_args: ``workflow -v error root.x=1`` must
+        # work — plain parse_args fills the config positional
+        # (nargs="*") with [] at the first optional and then reports
+        # trailing root.path=value overrides as unrecognized.
+        self.args = parser.parse_intermixed_args(self.argv)
         level = {"debug": logging.DEBUG, "info": logging.INFO,
                  "warning": logging.WARNING,
                  "error": logging.ERROR}[self.args.verbosity]
@@ -283,6 +287,17 @@ class Main(Logger, CommandLineBase):
             root.common.serving.token = args.serve_token
         if args.serve_warmup:
             root.common.serving.warmup = True
+        # Attention fast-path knobs (ops/attention.init_parser;
+        # docs/attention.md) — read back at unit construction
+        # (fused_qkv freezes the parameter layout) and inside the
+        # attention formulations (dtype/kernel dispatch).
+        if args.attn_fused_qkv is not None:
+            root.common.engine.fused_qkv = \
+                args.attn_fused_qkv == "on"
+        if args.attn_dtype is not None:
+            root.common.engine.attention_dtype = args.attn_dtype
+        if args.attn_kernel is not None:
+            root.common.engine.attention_kernel = args.attn_kernel
         # Distributed data-plane knobs (network_common.init_parser;
         # docs/distributed.md) — read back by the handshake
         # negotiation and the channels.

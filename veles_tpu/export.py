@@ -484,9 +484,15 @@ class ExportedModel(object):
         B, S, E = x.shape
         D = E // H
         h = ln(x, p["ln1_g"], p["ln1_b"])
-        q = (h @ p["wq"] + p["bq"]).reshape(B, S, H, D)
-        k = (h @ p["wk"] + p["bk"]).reshape(B, S, H, D)
-        v = (h @ p["wv"] + p["bv"]).reshape(B, S, H, D)
+        if "wqkv" in p:
+            # Fused-QKV artifact: one (E, 3E) head-major projection
+            # (znicz/attention.fuse_qkv_arrays layout).
+            qkv = (h @ p["wqkv"] + p["bqkv"]).reshape(B, S, H, 3, D)
+            q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        else:
+            q = (h @ p["wq"] + p["bq"]).reshape(B, S, H, D)
+            k = (h @ p["wk"] + p["bk"]).reshape(B, S, H, D)
+            v = (h @ p["wv"] + p["bv"]).reshape(B, S, H, D)
         scores = numpy.einsum("bqhd,bkhd->bhqk", q, k) / \
             numpy.sqrt(D)
         if causal:
@@ -668,6 +674,19 @@ class ExportedModel(object):
             ("fwd",) + tuple(x.shape), lambda: True)
         return self.forward(x)[:n]
 
+    @staticmethod
+    def _serving_attend(causal):
+        """The serving attention: f32 intermediates, XLA formulation
+        — PINNED, regardless of the attention fast-path knobs.  A
+        training process flipping ``attention_dtype``/``kernel``
+        must never change deployed bits (greedy decode is promised
+        bit-stable); the fast path reaches serving only through an
+        explicit future gate, not a global knob."""
+        import functools
+        from .ops.attention import attention
+        return functools.partial(attention, causal=causal,
+                                 precision="f32", kernel="xla")
+
     def _jax_chain(self, x):
         import jax
         import jax.numpy as jnp
@@ -706,7 +725,9 @@ class ExportedModel(object):
                      for n in entry["params"]}
                 x = transformer_block_apply(
                     p, x, int(cfg["n_heads"]),
-                    bool(cfg.get("causal", 1)), jnp.float32)
+                    bool(cfg.get("causal", 1)), jnp.float32,
+                    attend=self._serving_attend(
+                        bool(cfg.get("causal", 1))))
             elif t == "moe_transformer_block":
                 from .znicz.attention import transformer_block_apply
                 from .ops.moe import moe_ffn
@@ -725,6 +746,8 @@ class ExportedModel(object):
                 x = transformer_block_apply(
                     p, x, int(cfg["n_heads"]),
                     bool(cfg.get("causal", 1)), jnp.float32,
+                    attend=self._serving_attend(
+                        bool(cfg.get("causal", 1))),
                     mlp=moe_mlp)
             elif t == "lm_head":
                 w = self._param(entry, "weights")
@@ -832,9 +855,15 @@ class ExportedModel(object):
         D = E // H
         L = ck.shape[1]
         h = ln(x, p["ln1_g"], p["ln1_b"])
-        q = (h @ p["wq"] + p["bq"]).reshape(B, S_, H, D)
-        kn = (h @ p["wk"] + p["bk"]).reshape(B, S_, H, D)
-        vn = (h @ p["wv"] + p["bv"]).reshape(B, S_, H, D)
+        if "wqkv" in p:
+            # Fused-QKV artifact: same head-major (E, 3E) layout as
+            # the training/serving forward paths.
+            qkv = (h @ p["wqkv"] + p["bqkv"]).reshape(B, S_, H, 3, D)
+            q, kn, vn = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        else:
+            q = (h @ p["wq"] + p["bq"]).reshape(B, S_, H, D)
+            kn = (h @ p["wk"] + p["bk"]).reshape(B, S_, H, D)
+            vn = (h @ p["wv"] + p["bv"]).reshape(B, S_, H, D)
         ck = lax.dynamic_update_slice(ck, kn, (0, start, 0, 0))
         cv = lax.dynamic_update_slice(cv, vn, (0, start, 0, 0))
         if key_mask is None:
@@ -953,14 +982,17 @@ class ExportedModel(object):
         ``temperature`` == 0, else temperature sampling.  Returns the
         (B, prompt+new) token array — with ``return_logits``, also
         the (B, new, V) pre-sampling logits (what the parity tests
-        compare against the full forward).  Compiles once per
-        (prompt_len, max_new_tokens) geometry — temperature is a
-        TRACED input, deliberately excluded from the compile-cache
-        key (a serving client could otherwise force a fresh
-        multi-second jit per distinct float); the KV cache makes each
-        decode step O(L·E) instead of re-running the full O(L²)
-        forward (the incremental-serving obligation the reference's
-        RESTful role implies, restful_api.py:78)."""
+        compare against the full forward).  Prompt lengths round up
+        to a power-of-two bucket and ride the padded
+        ``generate_bucketed`` program (greedy output is bit-identical
+        — the bucketed parity gate), so a serving workload of
+        arbitrary lengths compiles O(log S) programs, one per bucket
+        — temperature stays a TRACED input, deliberately excluded
+        from the compile-cache key (a serving client could otherwise
+        force a fresh multi-second jit per distinct float); the KV
+        cache makes each decode step O(L·E) instead of re-running the
+        full O(L²) forward (the incremental-serving obligation the
+        reference's RESTful role implies, restful_api.py:78)."""
         import jax
         import jax.numpy as jnp
         prompt = numpy.atleast_2d(
@@ -972,11 +1004,40 @@ class ExportedModel(object):
         temperature = float(temperature)
         if not numpy.isfinite(temperature) or temperature < 0.0:
             raise Bug("temperature must be finite and >= 0")
+        S0, max_new = prompt.shape[1], int(max_new_tokens)
+        limit = self.max_position
+        if limit is not None and S0 + max_new > limit:
+            raise Bug(
+                "prompt %d + %d new tokens exceeds the model's "
+                "positional table (%d)" % (S0, max_new, limit))
+        if not return_logits:
+            # Decode-serving compile policy: round the prompt length
+            # up to a power-of-two bucket and ride the padded
+            # ``generate_bucketed`` path (greedy decode is
+            # bit-identical by the bucketed-parity gate), so a
+            # workload of arbitrary prompt lengths compiles O(log S)
+            # programs instead of one per distinct length.  The
+            # ``return_logits`` debugging path keeps the exact-length
+            # program (what the parity tests pin).
+            from .serving.buckets import bucket_of
+            B = prompt.shape[0]
+            S0b = bucket_of(S0, floor=16, cap=limit)
+            padded = numpy.zeros((B, S0b), dtype=numpy.int32)
+            padded[:, :S0] = prompt
+            # Per-row seeds: generate_bucketed folds a PRNG key per
+            # row, so a broadcast scalar would sample every row from
+            # the same stream (identical prompts → identical
+            # continuations at temperature > 0).  Greedy ignores the
+            # seed entirely, so this keeps the bit-identical gate.
+            gen = self.generate_bucketed(
+                padded, numpy.full(B, S0, dtype=numpy.int32),
+                max_new, temperatures=temperature,
+                seeds=(int(seed) + numpy.arange(B)) & 0xFFFFFFFF)
+            return numpy.concatenate([prompt, gen], axis=1)
         # Compile cache keyed ONLY by geometry (temperature is a
         # traced input), bounded LRU — the key is client-reachable
         # through the serving endpoint, so it must not grow without
         # bound.
-        S0, max_new = prompt.shape[1], int(max_new_tokens)
         fn = self.compile_cache.get_or_build(
             ("gen", S0, max_new),
             lambda: self._build_generate(S0, max_new))

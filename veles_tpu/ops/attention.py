@@ -12,12 +12,33 @@ S×S score matrix or the full K/V.
 Design notes (the "How to Scale Your Model" recipe):
   * all three formulations share one streaming-softmax block update —
     parity between them is structural, not coincidental;
-  * accumulation is float32 regardless of input dtype (bf16 scores
-    lose the softmax tail);
+  * the running max/normalizer (m, l) are ALWAYS float32 (bf16 loses
+    the softmax tail); the materialized score/probability tensors and
+    the output accumulator — the attention fast path's HBM traffic —
+    drop to bf16 under ``root.common.engine.attention_dtype="bf16"``
+    (per-block accumulation still happens in f32 and is rounded once
+    per block), gated by parity tests with documented tolerances;
   * everything is ``lax.scan``/``ppermute`` — differentiable, so the
     backward pass is the same ring reversed, inserted by autodiff;
   * causal masking works on GLOBAL positions: each ring step offsets
     its key block by the sending device's shard start.
+
+Attention fast path (BENCHNOTES round 6): three independently-gated
+stages attack the LM bench's attention gap (7.8 ms fwd+bwd measured
+vs ~1.5 ms of FLOP time at B=8/S=1024/H=16/D=128):
+
+  * ``root.common.engine.fused_qkv`` — one (E, 3E) projection matmul
+    per block instead of three (znicz/attention.py);
+  * ``root.common.engine.attention_dtype`` — "f32" (default) or
+    "bf16" score/accumulator intermediates (this module);
+  * ``root.common.engine.attention_kernel`` — "xla" (default),
+    "pallas", or "auto": route :func:`attention` /
+    :func:`blockwise_attention` through the geometry-tuned Pallas
+    flash kernel (ops/pallas_attention.py) when the platform
+    supports it.
+
+Each knob has a ``--attn-*`` CLI flag (init_parser below) and an A/B
+hook in ``bench.py --lm`` so the win is attributed per stage.
 """
 
 import functools
@@ -26,41 +47,137 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..config import root, get as config_get
+
 NEG_INF = -1e30
 
 #: Valid sequence-parallel strategies (single source of truth for
 #: sequence_parallel_attention and the unit-level validation).
 SP_MODES = ("ring", "ulysses")
 
+#: Valid attention-kernel dispatch modes.
+KERNEL_MODES = ("xla", "pallas", "auto")
+
+
+def init_parser(parser):
+    """Attention fast-path flags, aggregated into the velescli parser
+    (handed to ``root.common.engine`` by
+    ``__main__.apply_subsystem_flags``)."""
+    parser.add_argument(
+        "--attn-fused-qkv", default=None, choices=("on", "off"),
+        help="attention fast path: compute q/k/v with ONE (E, 3E) "
+             "projection matmul per transformer block instead of "
+             "three (E, E) matmuls (docs/attention.md)")
+    parser.add_argument(
+        "--attn-dtype", default=None, choices=("f32", "bf16"),
+        help="attention fast path: dtype of the materialized score/"
+             "probability tensors and output accumulator; bf16 "
+             "halves the attention block's HBM traffic at a "
+             "documented parity tolerance (serving stays f32)")
+    parser.add_argument(
+        "--attn-kernel", default=None, choices=KERNEL_MODES,
+        help="attention fast path: 'pallas' routes attention through "
+             "the geometry-tuned flash kernel "
+             "(ops/pallas_attention.py) where the platform supports "
+             "it, 'auto' probes, 'xla' (default) keeps the fused XLA "
+             "formulation")
+
+
+def attention_compute_dtype(precision=None):
+    """Resolves the score/accumulator dtype: the explicit
+    ``precision`` argument wins, else ``root.common.engine.
+    attention_dtype`` ("f32" default).  Unknown strings RAISE — a
+    typo'd config override must not silently run the f32 baseline
+    while the operator believes the bf16 stage is being measured."""
+    if precision is None:
+        precision = config_get(root.common.engine.attention_dtype,
+                               "f32")
+    if hasattr(precision, "dtype") or not isinstance(precision, str):
+        return jnp.dtype(precision).type
+    if precision == "bf16":
+        return jnp.bfloat16
+    if precision == "f32":
+        return jnp.float32
+    raise ValueError("unknown attention dtype %r — valid: 'f32', "
+                     "'bf16' (or a jnp dtype)" % (precision,))
+
+
+def _kernel_mode():
+    mode = str(config_get(root.common.engine.attention_kernel, "xla"))
+    if mode not in KERNEL_MODES:
+        raise ValueError("unknown attention kernel mode %r — valid: "
+                         "%s" % (mode, list(KERNEL_MODES)))
+    return mode
+
+
+def _try_pallas(q, k, v, causal, kv_len=None, mode=None,
+                precision=None):
+    """Routes through the Pallas flash kernel when the knob (or the
+    explicit ``mode`` override) asks for it AND the platform/geometry
+    supports it; returns None (→ caller falls through to the jnp
+    formulation) otherwise.  "pallas" and "auto" behave identically —
+    both degrade silently, so a CPU test run with the flag on still
+    exercises the reference path.  An explicit ``precision`` wins
+    inside the kernel too: it becomes the matmul operand dtype, so
+    ``precision="f32"`` is honored (exactly) rather than silently
+    downgraded to the kernel's bf16 default."""
+    if (mode or _kernel_mode()) == "xla":
+        return None
+    from . import pallas_attention as PA
+    if not PA.supports(q.shape, k.shape, kv_len):
+        return None
+    if not PA.pallas_attention_available():
+        return None
+    od = attention_compute_dtype(precision) \
+        if precision is not None else None
+    return PA.pallas_attention(q, k, v, causal=causal, kv_len=kv_len,
+                               operand_dtype=od)
+
 
 def _block_update(acc, m, l, q, k, v, *, scale, mask=None):
     """One streaming-softmax update: fold the (q·kᵀ) scores of a
     key/value block into the running (acc, m, l) accumulator.
 
-    Shapes: q (B, Sq, H, D); k/v (B, Sk, H, D); acc (B, Sq, H, D) f32;
-    m/l (B, Sq, H) f32.  ``mask`` (Sq, Sk) True = attend.
+    Shapes: q (B, Sq, H, D); k/v (B, Sk, H, D); acc (B, Sq, H, D) in
+    the caller-chosen compute dtype (``acc.dtype`` — f32 default,
+    bf16 under the fast-path knob); m/l (B, Sq, H) ALWAYS f32.
+    ``mask`` (Sq, Sk) True = attend.
+
+    In bf16 mode the materialized tensors (scores, probabilities,
+    the carried accumulator) are bf16 — the HBM traffic — while the
+    running statistics and each block's accumulation happen in f32
+    and are rounded ONCE per block, so the error is per-block
+    rounding, not compounding summation drift.
     """
-    scores = jnp.einsum("bqhd,bkhd->bqhk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+    dt = acc.dtype
+    # preferred_element_type stays f32: the q·kᵀ dot is a D-term sum
+    # whose ACCUMULATION must not round at bf16 (the materialized
+    # tensor — the HBM traffic — is still dt after the cast).
+    scores = (jnp.einsum("bqhd,bkhd->bqhk", q, k,
+                         preferred_element_type=jnp.float32) *
+              scale).astype(dt)
     if mask is not None:
-        scores = jnp.where(mask[None, :, None, :], scores, NEG_INF)
-    block_max = scores.max(axis=-1)
+        scores = jnp.where(mask[None, :, None, :], scores,
+                           jnp.asarray(NEG_INF, dt))
+    block_max = scores.max(axis=-1).astype(jnp.float32)
     new_m = jnp.maximum(m, block_max)
     correction = jnp.exp(m - new_m)
-    p = jnp.exp(scores - new_m[..., None])
+    p = jnp.exp(scores - new_m[..., None].astype(dt))
     if mask is not None:
         # exp(NEG_INF - m) underflows to 0 already; this guards the
         # fully-masked-row case where new_m itself is NEG_INF.
-        p = jnp.where(mask[None, :, None, :], p, 0.0)
-    new_l = l * correction + p.sum(axis=-1)
-    new_acc = acc * correction[..., None] + jnp.einsum(
-        "bqhk,bkhd->bqhd", p, v.astype(jnp.float32),
-        preferred_element_type=jnp.float32)
+        p = jnp.where(mask[None, :, None, :], p, jnp.asarray(0.0, dt))
+    new_l = l * correction + p.sum(axis=-1, dtype=jnp.float32)
+    pv = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(dt),
+                    preferred_element_type=jnp.float32)
+    new_acc = (acc.astype(jnp.float32) * correction[..., None] +
+               pv).astype(dt)
     return new_acc, new_m, new_l
 
 
 def _finish(acc, l, dtype):
-    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(dtype)
+    return (acc.astype(jnp.float32) /
+            jnp.maximum(l, 1e-30)[..., None]).astype(dtype)
 
 
 def _causal_mask(sq, sk, q_offset, k_offset):
@@ -69,14 +186,28 @@ def _causal_mask(sq, sk, q_offset, k_offset):
     return qpos >= kpos
 
 
-def attention(q, k, v, causal=False):
+def attention(q, k, v, causal=False, precision=None, kernel=None):
     """Full O(S²)-memory attention (B, S, H, D) — the reference
-    formulation the streaming variants are tested against."""
+    formulation the streaming variants are tested against.
+
+    ``precision``: None → the ``attention_dtype`` knob; "f32"/"bf16"
+    forces.  ``kernel``: None → the ``attention_kernel`` knob;
+    "xla" forces the jnp formulation — what the serving surfaces pin
+    so a training-process knob never changes deployed bits.  Under
+    "pallas"/"auto" the call routes through the Pallas flash kernel
+    when the platform supports the geometry (the kernel never
+    materializes the S×S scores, so the precision knob is moot
+    there beyond the matmul operand dtype)."""
+    out = _try_pallas(q, k, v, causal, mode=kernel,
+                      precision=precision)
+    if out is not None:
+        return out
+    dt = attention_compute_dtype(precision)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     mask = _causal_mask(q.shape[1], k.shape[1], 0, 0) if causal \
         else None
     B, Sq, H, D = q.shape
-    acc = jnp.zeros((B, Sq, H, D), jnp.float32)
+    acc = jnp.zeros((B, Sq, H, D), dt)
     m = jnp.full((B, Sq, H), NEG_INF, jnp.float32)
     l = jnp.zeros((B, Sq, H), jnp.float32)
     acc, m, l = _block_update(acc, m, l, q, k, v, scale=scale,
@@ -85,14 +216,25 @@ def attention(q, k, v, causal=False):
 
 
 def blockwise_attention(q, k, v, block_size=128, causal=False,
-                        kv_len=None):
+                        kv_len=None, precision=None, kernel=None):
     """Flash-style attention: scan over key/value blocks with the
     streaming accumulator — O(S·block) memory on one device.
 
     ``kv_len``: when set, keys at global positions >= kv_len are
     masked out — the padding contract for callers that padded k/v up
     to a block multiple (non-causal attention would otherwise attend
-    the zero padding)."""
+    the zero padding).
+
+    ``precision``/``kernel``: None → the ``attention_dtype`` /
+    ``attention_kernel`` knobs (explicit values force, as in
+    :func:`attention`).  Under "pallas"/"auto" the scan is replaced
+    wholesale by the Pallas flash kernel when the platform supports
+    the geometry."""
+    out = _try_pallas(q, k, v, causal, kv_len=kv_len, mode=kernel,
+                      precision=precision)
+    if out is not None:
+        return out
+    dt = attention_compute_dtype(precision)
     B, S, H, D = q.shape
     if S % block_size:
         raise ValueError("sequence %d not divisible by block %d" %
@@ -118,7 +260,7 @@ def blockwise_attention(q, k, v, block_size=128, causal=False,
                                   scale=scale, mask=mask)
         return (acc, m, l), None
 
-    init = (jnp.zeros((B, S, H, D), jnp.float32),
+    init = (jnp.zeros((B, S, H, D), dt),
             jnp.full((B, S, H), NEG_INF, jnp.float32),
             jnp.zeros((B, S, H), jnp.float32))
     (acc, m, l), _ = lax.scan(
@@ -138,6 +280,7 @@ def ring_attention(q, k, v, axis_name, causal=False):
     n = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
+    dt = attention_compute_dtype()
     scale = 1.0 / (D ** 0.5)
     q_offset = rank * Sq
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -156,7 +299,7 @@ def ring_attention(q, k, v, axis_name, causal=False):
         vr = lax.ppermute(vr, axis_name, perm)
         return (acc, m, l, kr, vr), None
 
-    init = (jnp.zeros((B, Sq, H, D), jnp.float32),
+    init = (jnp.zeros((B, Sq, H, D), dt),
             jnp.full((B, Sq, H), NEG_INF, jnp.float32),
             jnp.zeros((B, Sq, H), jnp.float32), k, v)
     (acc, m, l, _, _), _ = lax.scan(body, init, jnp.arange(n))

@@ -1,0 +1,414 @@
+"""Pallas flash attention tuned to THIS repo's LM geometry.
+
+Round-5 measured the two off-the-shelf Pallas kernels (flash, splash)
+LOSING to XLA's fused full attention at the bench geometry
+(B=8/S=1024/H=16/D=128: XLA 7.8 ms, flash 10.5, splash 10.9 fwd+bwd)
+— their block shapes are tuned for large-batch GPU-style launches,
+not a 128-lane head dim at batch 8.  This kernel makes the opposite
+choices, for exactly one geometry family:
+
+  * D is the FULL lane width (D % 128 == 0) — one q/k/v row is one
+    (or a few) native (8, 128) tiles, no head-dim blocking ever;
+  * k/v for a (batch·head) slice live WHOLE in VMEM (S ≤ 2048 ×
+    D=128 × 4 B = 1 MB each — a fraction of 16 MB), so the only
+    streaming dimension is the query block: grid (B·H, S/block_q),
+    with the key loop a ``fori_loop`` over VMEM, never HBM;
+  * matmul operands are bf16 (MXU-native), accumulation f32
+    (``preferred_element_type``), the online-softmax statistics f32 —
+    the same contract as ops/attention's bf16 mode;
+  * the backward recomputes probabilities from the saved logsumexp
+    (FLOPs are free at this arithmetic intensity; HBM traffic is
+    not): one kernel produces dq gridded over query blocks, one
+    produces dk/dv gridded over key blocks — no atomics, no
+    cross-block races.
+
+Like pallas_lrn.py, the module ships three layers: the kernel, a
+reference-parity fallback (ops/attention.blockwise_attention — the
+parity oracle the tests pin), and an availability probe so dispatch
+(ops/attention._try_pallas) degrades silently off-TPU.
+
+HBM-traffic budget at the bench geometry (B=8, S=1024, H=16, D=128):
+q/k/v/o are 64 MB each in f32; the fwd reads q/k/v once and writes
+o + lse ≈ 0.26 GB, the bwd reads them + do and writes dq/dk/dv ≈
+0.45 GB — ~0.9 ms at 819 GB/s vs the 6.4 GB (7.8 ms) the XLA
+formulation moves through its materialized f32 score/probability
+tensors.  That 8× traffic cut is the whole thesis; BENCHNOTES r6
+carries the A/B protocol (``bench.py --lm --attn-stages=...``).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+#: Default query block: 512 rows × 128 lanes × 4 B = 256 KB of q per
+#: grid step; the (block_q, S) score tile peaks at 512×2048×4 = 4 MB
+#: f32 — comfortable VMEM at both target sequence lengths.
+DEFAULT_BLOCK_Q = 512
+#: Key loop step inside the kernel (VMEM-resident, so this only sets
+#: the score-tile width): S=1024 runs the loop once, S=2048 twice.
+DEFAULT_BLOCK_K = 1024
+
+#: Geometry contract: lane-native head dim, tile-aligned sequence.
+LANE = 128
+
+#: Upper sequence bound: the kernel keeps a (batch·head) slice's
+#: whole k/v in VMEM (S × D × 4 B each) plus a (block_q, S) f32
+#: score tile — at S=2048/D=128 that is 2 × 1 MB + 4 MB, comfortable
+#: in 16 MB; past it the tiles stop fitting and dispatch must fall
+#: back to the streaming scan instead of dying in the compiler.
+MAX_SEQ = 2048
+
+
+def _pick_block(n, want):
+    """Largest power-of-two divisor of ``n`` that is <= ``want``
+    (n is a multiple of LANE by the ``supports`` contract)."""
+    b = 1
+    while b * 2 <= want and n % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def supports(q_shape, k_shape, kv_len=None):
+    """Whether the kernel's geometry contract holds: self-attention
+    ((B, S, H, D) with equal q/k sequence), D lane-native, S
+    tile-aligned.  ``kv_len`` (the blockwise padding contract) is
+    supported as a static mask bound."""
+    if len(q_shape) != 4 or len(k_shape) != 4:
+        return False
+    B, S, H, D = q_shape
+    if k_shape[1] != S:
+        return False
+    if D % LANE or D > 4 * LANE:
+        return False
+    if S % LANE or S < LANE or S > MAX_SEQ:
+        return False
+    if kv_len is not None and not isinstance(kv_len, int):
+        return False
+    return True
+
+
+# -- kernels -------------------------------------------------------------
+
+
+def _mask_tile(rows0, cols0, bq, bk, causal, kv_len):
+    """(bq, bk) boolean attend-mask for the tile whose global row/col
+    origins are rows0/cols0, or None when nothing masks."""
+    mask = None
+    if causal:
+        rows = rows0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = cols0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = rows >= cols
+    if kv_len is not None:
+        cols = cols0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        kvm = cols < kv_len
+        mask = kvm if mask is None else jnp.logical_and(mask, kvm)
+    return mask
+
+
+def _dot(a, b, od, trans_b=False):
+    """MXU matmul: ``od`` operands (bf16 in production, f32 for the
+    exact-parity tests), f32 accumulation."""
+    dims = (((1,), (1,) if trans_b else (0,)), ((), ()))
+    return jax.lax.dot_general(a.astype(od), b.astype(od), dims,
+                               preferred_element_type=jnp.float32)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                causal, kv_len, block_k, seq_len, od):
+    from jax.experimental import pallas as pl
+    bq = q_ref.shape[1]
+    D = q_ref.shape[2]
+    i = pl.program_id(1)
+    q = q_ref[0]
+    q_off = i * bq
+    nk = seq_len // block_k
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = _dot(q, kb, od, trans_b=True) * scale
+        mask = _mask_tile(q_off, j * block_k, bq, block_k, causal,
+                          kv_len)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        bm = s.max(axis=1, keepdims=True)
+        new_m = jnp.maximum(m, bm)
+        corr = jnp.exp(m - new_m)
+        p = jnp.exp(s - new_m)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        new_l = l * corr + p.sum(axis=1, keepdims=True)
+        acc = acc * corr + _dot(p, vb, od)
+        return acc, new_m, new_l
+
+    acc, m, l = jax.lax.fori_loop(
+        0, nk, body,
+        (jnp.zeros((bq, D), jnp.float32),
+         jnp.full((bq, 1), NEG_INF, jnp.float32),
+         jnp.zeros((bq, 1), jnp.float32)))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # Fully-masked rows keep m = NEG_INF so lse ≈ -1e30 (finite, not
+    # -inf); the bwd kernels do NOT rely on exp(s - lse) underflowing
+    # for such rows — they re-mask p with jnp.where before use.
+    lse_ref[0, :] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, *, scale, causal, kv_len, block_k, seq_len,
+               od):
+    from jax.experimental import pallas as pl
+    bq = q_ref.shape[1]
+    D = q_ref.shape[2]
+    i = pl.program_id(1)
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, :][:, None]
+    delta = delta_ref[0, :][:, None]
+    q_off = i * bq
+    nk = seq_len // block_k
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = _dot(q, kb, od, trans_b=True) * scale
+        mask = _mask_tile(q_off, j * block_k, bq, block_k, causal,
+                          kv_len)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dp = _dot(do, vb, od, trans_b=True)
+        ds = p * (dp - delta) * scale
+        return dq + _dot(ds, kb, od)
+
+    dq_ref[0] = jax.lax.fori_loop(
+        0, nk, body,
+        jnp.zeros((bq, D), jnp.float32)).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, kv_len, block_q,
+                seq_len, od):
+    from jax.experimental import pallas as pl
+    bk = k_ref.shape[1]
+    D = k_ref.shape[2]
+    j = pl.program_id(1)
+    k = k_ref[0]
+    v = v_ref[0]
+    k_off = j * bk
+    nq = seq_len // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :]
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        s = _dot(qb, k, od, trans_b=True) * scale
+        mask = _mask_tile(i * block_q, k_off, block_q, bk, causal,
+                          kv_len)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        dv = dv + _dot(p.T, dob, od)
+        dp = _dot(dob, v, od, trans_b=True)
+        ds = p * (dp - delta) * scale
+        dk = dk + _dot(ds.T, qb, od)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        0, nq, body,
+        (jnp.zeros((bk, D), jnp.float32),
+         jnp.zeros((bk, D), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# -- pallas_call plumbing ------------------------------------------------
+
+
+def _row_spec(block, D, which):
+    """BlockSpec over (BH, S, D) arrays: ``which`` "blocked" walks
+    grid dim 1 in ``block``-row steps, "whole" keeps the full
+    sequence resident per (batch·head)."""
+    from jax.experimental import pallas as pl
+    if which == "blocked":
+        return pl.BlockSpec((1, block, D), lambda b, i: (b, i, 0))
+    return pl.BlockSpec((1, block, D), lambda b, i: (b, 0, 0))
+
+
+def _vec_spec(block, which):
+    """BlockSpec over (BH, S) row vectors (lse/delta)."""
+    from jax.experimental import pallas as pl
+    if which == "blocked":
+        return pl.BlockSpec((1, block), lambda b, i: (b, i))
+    return pl.BlockSpec((1, block), lambda b, i: (b, 0))
+
+
+def _flash_fwd_flat(qf, kf, vf, causal, kv_len, bq, bk, od,
+                    interpret):
+    """(BH, S, D) forward: returns (out, lse)."""
+    from jax.experimental import pallas as pl
+    BH, S, D = qf.shape
+    scale = 1.0 / (D ** 0.5)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             kv_len=kv_len, block_k=bk, seq_len=S,
+                             od=od)
+    return pl.pallas_call(
+        kern,
+        grid=(BH, S // bq),
+        in_specs=[_row_spec(bq, D, "blocked"),
+                  _row_spec(S, D, "whole"),
+                  _row_spec(S, D, "whole")],
+        out_specs=(_row_spec(bq, D, "blocked"),
+                   _vec_spec(bq, "blocked")),
+        out_shape=(jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+                   jax.ShapeDtypeStruct((BH, S), jnp.float32)),
+        interpret=interpret,
+    )(qf, kf, vf)
+
+
+def _flash_bwd_flat(qf, kf, vf, of, dof, lse, causal, kv_len, bq, bk,
+                    od, interpret):
+    from jax.experimental import pallas as pl
+    BH, S, D = qf.shape
+    scale = 1.0 / (D ** 0.5)
+    # delta_i = Σ_d dO·O — tiny elementwise pass, left to XLA.
+    delta = (dof.astype(jnp.float32) *
+             of.astype(jnp.float32)).sum(axis=-1)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          kv_len=kv_len, block_k=bk, seq_len=S,
+                          od=od),
+        grid=(BH, S // bq),
+        in_specs=[_row_spec(bq, D, "blocked"),
+                  _row_spec(S, D, "whole"),
+                  _row_spec(S, D, "whole"),
+                  _row_spec(bq, D, "blocked"),
+                  _vec_spec(bq, "blocked"),
+                  _vec_spec(bq, "blocked")],
+        out_specs=_row_spec(bq, D, "blocked"),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          kv_len=kv_len, block_q=bq, seq_len=S,
+                          od=od),
+        grid=(BH, S // bk),
+        in_specs=[_row_spec(S, D, "whole"),
+                  _row_spec(bk, D, "blocked"),
+                  _row_spec(bk, D, "blocked"),
+                  _row_spec(S, D, "whole"),
+                  _vec_spec(S, "whole"),
+                  _vec_spec(S, "whole")],
+        out_specs=(_row_spec(bk, D, "blocked"),
+                   _row_spec(bk, D, "blocked")),
+        out_shape=(jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), qf.dtype)),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+    return dq, dk, dv
+
+
+# -- differentiable (B, S, H, D) entry point -----------------------------
+
+
+def _to_flat(x):
+    B, S, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, S, D)
+
+
+def _from_flat(xf, B, H):
+    BH, S, D = xf.shape
+    return xf.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, kv_len, bq, bk, od, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, kv_len, bq, bk, od,
+                        interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, kv_len, bq, bk, od, interpret):
+    B, S, H, D = q.shape
+    of, lse = _flash_fwd_flat(_to_flat(q), _to_flat(k), _to_flat(v),
+                              causal, kv_len, bq, bk, od, interpret)
+    return _from_flat(of, B, H), (q, k, v, _from_flat(of, B, H), lse)
+
+
+def _flash_bwd(causal, kv_len, bq, bk, od, interpret, res, do):
+    q, k, v, out, lse = res
+    B, S, H, D = q.shape
+    dqf, dkf, dvf = _flash_bwd_flat(
+        _to_flat(q), _to_flat(k), _to_flat(v), _to_flat(out),
+        _to_flat(do), lse, causal, kv_len, bq, bk, od, interpret)
+    return (_from_flat(dqf, B, H), _from_flat(dkf, B, H),
+            _from_flat(dvf, B, H))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def pallas_attention(q, k, v, causal=False, kv_len=None, block_q=None,
+                     block_k=None, operand_dtype=None,
+                     interpret=False):
+    """Flash attention over (B, S, H, D), differentiable (custom
+    VJP).  Block shapes default to the geometry-tuned constants,
+    shrunk to the largest power-of-two divisor of S — callers outside
+    the ``supports`` contract must not reach here.
+
+    ``operand_dtype``: matmul operand dtype — bf16 (default, the MXU
+    contract) or f32 (the exact-parity test mode)."""
+    B, S, H, D = q.shape
+    if not supports(q.shape, k.shape, kv_len):
+        raise ValueError(
+            "geometry (%s, kv_len=%r) outside the pallas_attention "
+            "contract — use ops.attention.blockwise_attention" %
+            (q.shape, kv_len))
+    bq = _pick_block(S, block_q or DEFAULT_BLOCK_Q)
+    bk = _pick_block(S, block_k or DEFAULT_BLOCK_K)
+    od = jnp.dtype(operand_dtype or jnp.bfloat16).type
+    if kv_len is not None:
+        kv_len = int(kv_len)
+    return _flash(q, k, v, bool(causal), kv_len, bq, bk, od,
+                  bool(interpret))
+
+
+# -- availability --------------------------------------------------------
+
+_available = [None]
+
+
+def pallas_attention_available():
+    """True when the live backend compiles and runs the kernel (cached
+    probe, same contract as pallas_lrn.tpu_available but end-to-end:
+    a toolchain that lowers LRN but chokes on this kernel's fori_loop
+    must read as unavailable, not crash the training step)."""
+    if _available[0] is None:
+        from .pallas_lrn import tpu_available
+        if not tpu_available():
+            _available[0] = False
+        else:
+            try:
+                x = jnp.zeros((1, LANE, 1, LANE), jnp.float32)
+                jax.block_until_ready(
+                    pallas_attention(x, x, x, causal=True))
+                _available[0] = True
+            except Exception:
+                _available[0] = False
+    return _available[0]
+
+
+def reset_probe():
+    """Clears the cached availability probe (tests, backend swaps)."""
+    _available[0] = None

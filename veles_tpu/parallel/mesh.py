@@ -102,7 +102,12 @@ def _transformer_tp_plan(unit, n_model, model_axis):
       * attention: wq/wk/wv COLUMN-sharded (each model shard computes
         E/n output features = H/n whole heads; the (B,S,H,D) reshape
         keeps the head dim sharded because n | H), wo ROW-sharded
-        (partial sums psum to a replicated residual);
+        (partial sums psum to a replicated residual); the FUSED
+        (E, 3E) wqkv shards its 3E column dim the same way — its
+        head-major layout ([q_h|k_h|v_h] per head) means a contiguous
+        3E/n column shard is H/n whole heads' q/k/v, so the
+        (B,S,H,3,D) reshape keeps the head dim sharded and the q/k/v
+        split indexes a replicated axis;
       * MLP: w1 column, w2 row — the hidden dim lives sharded, the
         residual stream stays replicated;
       * MoE experts: same column/row pairing on the per-expert
@@ -143,6 +148,10 @@ def _transformer_tp_plan(unit, n_model, model_axis):
             plan = {
                 "wq": col, "wk": col, "wv": col, "wo": row,
                 "bq": vec, "bk": vec, "bv": vec, "bo": rep,
+                # Fused layout: the 3E column dim is head-major, so a
+                # column shard is whole heads' q/k/v (see the wqkv
+                # note above).
+                "wqkv": col, "bqkv": vec,
                 "ln1_g": rep, "ln1_b": rep,
                 "ln2_g": rep, "ln2_b": rep,
                 "router": rep,
@@ -158,6 +167,7 @@ def _transformer_tp_plan(unit, n_model, model_axis):
                 "wv": (None,) + col, "wo": (None,) + row,
                 "bq": (None,) + vec, "bk": (None,) + vec,
                 "bv": (None,) + vec, "bo": (None,) + rep,
+                "wqkv": (None,) + col, "bqkv": (None,) + vec,
                 "ln1_g": (None,) + rep, "ln1_b": (None,) + rep,
                 "ln2_g": (None,) + rep, "ln2_b": (None,) + rep,
                 "w1": (None,) + col, "b1": (None,) + vec,
@@ -167,6 +177,7 @@ def _transformer_tp_plan(unit, n_model, model_axis):
             plan = {
                 "wq": col, "wk": col, "wv": col, "wo": row,
                 "bq": vec, "bk": vec, "bv": vec, "bo": rep,
+                "wqkv": col, "bqkv": vec,
                 "ln1_g": rep, "ln1_b": rep,
                 "ln2_g": rep, "ln2_b": rep,
                 "w1": col, "b1": vec, "w2": row, "b2": rep,

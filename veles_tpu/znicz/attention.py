@@ -38,6 +38,68 @@ def remat_enabled(unit_flag):
     return bool(config_get(root.common.engine.remat, False))
 
 
+def fused_qkv_enabled(unit_flag):
+    """Whether a transformer unit computes q/k/v with ONE (E, 3E)
+    matmul (the attention fast path's stage (a)): the unit kwarg wins
+    when set, otherwise ``root.common.engine.fused_qkv`` (default
+    off).  The fused weight's column layout is HEAD-MAJOR —
+    ``[q_h | k_h | v_h]`` per head — so a Megatron column shard of
+    the 3E dim holds whole heads' q/k/v together and the
+    (B, S, H, 3, D) reshape splits q/k/v on a replicated axis (no
+    resharding), which is what lets the fused projection compose
+    with tensor parallelism."""
+    if unit_flag is not None:
+        return bool(unit_flag)
+    return bool(config_get(root.common.engine.fused_qkv, False))
+
+
+def fuse_qkv_arrays(wq, wk, wv, n_heads):
+    """Fuses three projection arrays into the head-major (…, 3·O)
+    layout.  Trailing-dim based, so it handles (E, O) weights, (O,)
+    biases, and stage-stacked (L, E, O) weights alike."""
+    wq, wk, wv = (numpy.asarray(w) for w in (wq, wk, wv))
+    O = wq.shape[-1]
+    D = O // n_heads
+    parts = [w.reshape(w.shape[:-1] + (n_heads, 1, D))
+             for w in (wq, wk, wv)]
+    return numpy.ascontiguousarray(
+        numpy.concatenate(parts, axis=-2).reshape(
+            wq.shape[:-1] + (3 * O,)))
+
+
+def split_qkv_arrays(wqkv, n_heads):
+    """Inverse of :func:`fuse_qkv_arrays`: (…, 3·O) → three (…, O)
+    arrays (wq, wk, wv)."""
+    wqkv = numpy.asarray(wqkv)
+    O = wqkv.shape[-1] // 3
+    D = O // n_heads
+    r = wqkv.reshape(wqkv.shape[:-1] + (n_heads, 3, D))
+    return tuple(
+        numpy.ascontiguousarray(
+            r[..., t, :].reshape(wqkv.shape[:-1] + (O,)))
+        for t in range(3))
+
+
+#: The per-projection parameter names the fused layout replaces.
+_QKV_NAMES = ("wq", "wk", "wv", "bq", "bk", "bv")
+
+
+def qkv_param_names(names, fused):
+    """Rewrites a canonical PARAM_NAMES tuple for the fused layout:
+    wq/wk/wv → wqkv, bq/bk/bv → bqkv (order of first occurrence)."""
+    if not fused:
+        return tuple(names)
+    out = []
+    for n in names:
+        if n in _QKV_NAMES:
+            repl = "wqkv" if n.startswith("w") else "bqkv"
+            if repl not in out:
+                out.append(repl)
+        else:
+            out.append(n)
+    return tuple(out)
+
+
 def _layer_norm(x, gamma, beta, eps=1e-5):
     import jax.numpy as jnp
     xf = x.astype(jnp.float32)
@@ -64,9 +126,21 @@ def transformer_block_apply(params, x, n_heads, causal, cdt,
                        preferred_element_type=jnp.float32) + b
 
     h = _layer_norm(x, params["ln1_g"], params["ln1_b"])
-    q = dot(h, params["wq"], params["bq"]).reshape(B, S, n_heads, -1)
-    k = dot(h, params["wk"], params["bk"]).reshape(B, S, n_heads, -1)
-    v = dot(h, params["wv"], params["bv"]).reshape(B, S, n_heads, -1)
+    if "wqkv" in params:
+        # Fast path stage (a): one (E, 3E) matmul; the head-major
+        # column layout makes the q/k/v split a reshape + index on a
+        # replicated axis (tensor-parallel-safe, see
+        # fused_qkv_enabled).
+        qkv = dot(h, params["wqkv"], params["bqkv"]).reshape(
+            B, S, n_heads, 3, -1)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    else:
+        q = dot(h, params["wq"], params["bq"]).reshape(
+            B, S, n_heads, -1)
+        k = dot(h, params["wk"], params["bk"]).reshape(
+            B, S, n_heads, -1)
+        v = dot(h, params["wv"], params["bv"]).reshape(
+            B, S, n_heads, -1)
     if attend is None:
         attend = functools.partial(A.attention, causal=causal)
     attn = attend(q.astype(cdt), k.astype(cdt),
@@ -81,20 +155,32 @@ def transformer_block_apply(params, x, n_heads, causal, cdt,
     return x.astype(jnp.float32)
 
 
-def _block_param_shapes(embed, hidden):
+def _block_param_shapes(embed, hidden, fused_qkv=False):
     """Parameter geometry of one dense pre-LN block — single source
     of truth for TransformerBlock and the pipelined stack (which
-    prepends a stage dim)."""
-    return {
-        "ln1_g": (embed,), "ln1_b": (embed,),
-        "wq": (embed, embed), "wk": (embed, embed),
-        "wv": (embed, embed), "wo": (embed, embed),
-        "bq": (embed,), "bk": (embed,), "bv": (embed,),
-        "bo": (embed,),
+    prepends a stage dim).  ``fused_qkv`` swaps the three (E, E)
+    projections for the single (E, 3E) fused weight.
+
+    Dict ORDER is load-bearing: initialization draws from the seeded
+    prng in iteration order, so the unfused layout must keep the
+    historical ordering bit-for-bit (seeded trajectories — and the
+    tests pinning them — depend on it)."""
+    if fused_qkv:
+        proj = {"wqkv": (embed, 3 * embed), "wo": (embed, embed),
+                "bqkv": (3 * embed,), "bo": (embed,)}
+    else:
+        proj = {"wq": (embed, embed), "wk": (embed, embed),
+                "wv": (embed, embed), "wo": (embed, embed),
+                "bq": (embed,), "bk": (embed,), "bv": (embed,),
+                "bo": (embed,)}
+    shapes = {"ln1_g": (embed,), "ln1_b": (embed,)}
+    shapes.update(proj)
+    shapes.update({
         "ln2_g": (embed,), "ln2_b": (embed,),
         "w1": (embed, hidden), "b1": (hidden,),
         "w2": (hidden, embed), "b2": (embed,),
-    }
+    })
+    return shapes
 
 
 class Embedding(ForwardBase):
@@ -183,7 +269,13 @@ class TransformerBlock(ForwardBase):
         self.head_axis = kwargs.get("head_axis")
         #: None → follow root.common.engine.remat; True/False forces.
         self.remat = kwargs.get("remat")
-        self.params = {name: Vector() for name in self.PARAM_NAMES}
+        #: Resolved at construction (None → the engine knob) so the
+        #: parameter LAYOUT is frozen into the unit — a snapshot
+        #: trained fused restores fused whatever the config says.
+        self.fused_qkv = fused_qkv_enabled(kwargs.get("fused_qkv"))
+        self.params = {name: Vector()
+                       for name in qkv_param_names(self.PARAM_NAMES,
+                                                   self.fused_qkv)}
 
     @property
     def trainables(self):
@@ -198,7 +290,8 @@ class TransformerBlock(ForwardBase):
                              % (embed, self.n_heads))
         hidden = embed * self.mlp_ratio
         stddev = self.weights_stddev or (1.0 / numpy.sqrt(embed))
-        shapes = _block_param_shapes(embed, hidden)
+        shapes = _block_param_shapes(embed, hidden,
+                                     fused_qkv=self.fused_qkv)
         for name, shape in shapes.items():
             vec = self.params[name]
             if vec:
@@ -356,8 +449,13 @@ class PipelinedTransformerStack(ForwardBase):
         self.n_microbatches = kwargs.get("n_microbatches", 4)
         #: None → follow root.common.engine.remat; True/False forces.
         self.remat = kwargs.get("remat")
+        #: Fused-QKV layout, frozen at construction like
+        #: TransformerBlock's.
+        self.fused_qkv = fused_qkv_enabled(kwargs.get("fused_qkv"))
         self.params = {name: Vector()
-                       for name in TransformerBlock.PARAM_NAMES}
+                       for name in qkv_param_names(
+                           TransformerBlock.PARAM_NAMES,
+                           self.fused_qkv)}
 
     @property
     def trainables(self):
@@ -372,7 +470,8 @@ class PipelinedTransformerStack(ForwardBase):
                              % (embed, self.n_heads))
         hidden = embed * self.mlp_ratio
         stddev = self.weights_stddev or (1.0 / numpy.sqrt(embed))
-        shapes = _block_param_shapes(embed, hidden)
+        shapes = _block_param_shapes(embed, hidden,
+                                     fused_qkv=self.fused_qkv)
         for name, shape in shapes.items():
             vec = self.params[name]
             if vec:

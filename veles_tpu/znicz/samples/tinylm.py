@@ -63,7 +63,7 @@ class TinyLMWorkflow(AcceleratedWorkflow):
                  gradient_moment=0.9, max_epochs=8, seq_axis=None,
                  sp_mode="ring",
                  n_experts=0, expert_axis=None, pipelined=False,
-                 stage_axis=None, n_microbatches=4,
+                 stage_axis=None, n_microbatches=4, fused_qkv=None,
                  loader_cls=FirstTokenLoader, loader_config=None,
                  **kwargs):
         super(TinyLMWorkflow, self).__init__(workflow, **kwargs)
@@ -92,7 +92,8 @@ class TinyLMWorkflow(AcceleratedWorkflow):
             stack = PipelinedTransformerStack(
                 self, n_blocks=n_blocks, n_heads=n_heads,
                 causal=True, stage_axis=stage_axis,
-                n_microbatches=n_microbatches, name="stack")
+                n_microbatches=n_microbatches, fused_qkv=fused_qkv,
+                name="stack")
             stack.link_from(prev)
             stack.input = prev.output
             self.forwards.append(stack)
@@ -103,13 +104,13 @@ class TinyLMWorkflow(AcceleratedWorkflow):
                 block = MoETransformerBlock(
                     self, n_heads=n_heads, causal=True,
                     seq_axis=seq_axis, sp_mode=sp_mode,
-                    n_experts=n_experts,
+                    n_experts=n_experts, fused_qkv=fused_qkv,
                     expert_axis=expert_axis, name="block%d" % i)
             else:
                 block = TransformerBlock(
                     self, n_heads=n_heads, causal=True,
                     seq_axis=seq_axis, sp_mode=sp_mode,
-                    name="block%d" % i)
+                    fused_qkv=fused_qkv, name="block%d" % i)
             block.link_from(prev)
             block.input = prev.output
             self.forwards.append(block)
