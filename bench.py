@@ -29,6 +29,16 @@ cross-round continuity.  ``--attn-stages=fused,bf16,pallas`` (or
 per-stage A/B attribution protocol (docs/attention.md,
 BENCHNOTES r6); the chosen set rides the JSON line.
 
+``python bench.py --serve`` runs the serving load benchmark
+(BENCH_r06): an in-process ``ServingEngine`` over a randomly-weighted
+LM artifact, driven by ``--serve-streams`` (default 64) concurrent
+client threads with mixed prompt lengths and decode budgets for
+``--serve-seconds`` per mode.  Reports sustained generated tok/s,
+p50/p99 time-to-first-token and inter-token latency, KV block-pool
+occupancy, and 429 sheds past pool exhaustion — first through the
+paged decode-step continuous-batching path, then the same workload
+through whole-request batching (``vs_baseline`` = paged/dense tok/s).
+
 ``python bench.py --streamed-jpeg`` decodes REAL JPEG files (a
 synthetic directory tree written once) through the streamed loader's
 host worker pool — decode + double-buffered upload + fused dispatch
@@ -153,6 +163,246 @@ JPEG_VALID_PER_CLASS = 16
 JPEG_BATCH = 64
 JPEG_TICKS_PER_DISPATCH = 4
 JPEG_BYTES_PER_IMG = JPEG_SIZE * JPEG_SIZE * 3 * 4  # float32
+
+
+# Serving bench geometry: a compact but real causal LM (random
+# weights — the bench measures the SERVING substrate: paged
+# gather/scatter decode, continuous batching, admission — not model
+# quality), sized so prefill+decode exercise real attention math
+# while the bucket grid stays small enough to warm up quickly.
+SERVE_VOCAB = 512
+SERVE_EMBED = 128
+SERVE_HEADS = 4
+SERVE_POS = 1024
+SERVE_HIDDEN = 256
+SERVE_BLOCKS = 4
+SERVE_STREAMS = 64
+SERVE_SECONDS = 15.0
+SERVE_MAX_BATCH = 32
+SERVE_KV_BLOCK = 16
+SERVE_PROMPT_CHOICES = (8, 24, 48, 96, 160)
+SERVE_NEW_CHOICES = (8, 16, 24, 40, 64)
+#: Fraction of streams that open with a common "system prompt" so
+#: the prefix cache has something to share.
+SERVE_SHARED_PREFIX = 32
+
+
+def build_serve_artifact(path):
+    """Writes a randomly-weighted causal-LM artifact (embedding →
+    blocks → lm_head) without training — serving economics do not
+    depend on the weights."""
+    import io
+    import tarfile
+    import numpy
+    from veles_tpu.json_encoders import dumps_json
+    rng = numpy.random.RandomState(1234)
+
+    def g(*shape):
+        return (rng.standard_normal(shape) * 0.5).astype(
+            numpy.float32)
+
+    weights = {"emb__weights": g(SERVE_VOCAB, SERVE_EMBED),
+               "emb__pos": g(SERVE_POS, SERVE_EMBED)}
+    units = [{"name": "emb", "type": "embedding",
+              "config": {"vocab_size": SERVE_VOCAB,
+                         "embed_dim": SERVE_EMBED},
+              "params": {"weights": "emb__weights",
+                         "pos": "emb__pos"}}]
+    E, H = SERVE_EMBED, SERVE_HIDDEN
+    for b in range(SERVE_BLOCKS):
+        name = "blk%d" % b
+        params = {}
+        for pname, shape in [
+                ("ln1_g", (E,)), ("ln1_b", (E,)),
+                ("wq", (E, E)), ("bq", (E,)), ("wk", (E, E)),
+                ("bk", (E,)), ("wv", (E, E)), ("bv", (E,)),
+                ("wo", (E, E)), ("bo", (E,)),
+                ("ln2_g", (E,)), ("ln2_b", (E,)),
+                ("w1", (E, H)), ("b1", (H,)),
+                ("w2", (H, E)), ("b2", (E,))]:
+            key = "%s__%s" % (name, pname)
+            weights[key] = numpy.ones(shape, numpy.float32) \
+                if pname.endswith("_g") else g(*shape)
+            params[pname] = key
+        units.append({"name": name, "type": "transformer_block",
+                      "config": {"n_heads": SERVE_HEADS,
+                                 "causal": 1},
+                      "params": params})
+    weights["head__weights"] = g(SERVE_EMBED, SERVE_VOCAB)
+    units.append({"name": "head", "type": "lm_head",
+                  "config": {"output_sample_shape": [SERVE_VOCAB]},
+                  "params": {"weights": "head__weights"}})
+    manifest = {"format": "veles-tpu-model", "version": 1,
+                "workflow": "ServeBench", "checksum": "bench",
+                "created": "1970-01-01T00:00:00Z",
+                "input": {"sample_shape": [8], "dtype": "int32"},
+                "output": {"sample_shape": [SERVE_VOCAB]},
+                "units": units}
+    npz = io.BytesIO()
+    numpy.savez(npz, **weights)
+    blobs = {"manifest.json": dumps_json(manifest).encode(),
+             "weights.npz": npz.getvalue()}
+    with tarfile.open(path, "w:gz") as tar:
+        for name, blob in blobs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tar.addfile(info, io.BytesIO(blob))
+    return path
+
+
+def run_serve_load(engine, streams, seconds, seed=0):
+    """Drives ``streams`` concurrent client threads against the
+    engine in-process for ``seconds``; returns aggregate client-side
+    numbers (the engine's ServingStats carries the server-side
+    TTFT/ITL/pool views)."""
+    import threading
+    import numpy
+    from veles_tpu.serving import AdmissionError
+    stop_at = time.monotonic() + seconds
+    lock = threading.Lock()
+    totals = {"tokens": 0, "requests": 0, "shed": 0, "timeouts": 0,
+              "errors": 0, "pool_peak": 0}
+    shared_prefix = numpy.random.RandomState(99).randint(
+        0, SERVE_VOCAB, 64).astype(numpy.int32)
+
+    def stream(idx):
+        rng = numpy.random.RandomState(seed * 1000 + idx)
+        while time.monotonic() < stop_at:
+            s = int(rng.choice(SERVE_PROMPT_CHOICES))
+            m = int(rng.choice(SERVE_NEW_CHOICES))
+            prompt = rng.randint(0, SERVE_VOCAB, (1, s)) \
+                .astype(numpy.int32)
+            if idx < SERVE_SHARED_PREFIX and s >= 48:
+                # A common system prompt: the prefix-cache's food.
+                prompt[0, :32] = shared_prefix[:32]
+            try:
+                out = engine.submit_generate(prompt, m,
+                                             seed=idx)
+                with lock:
+                    totals["tokens"] += int(out.shape[1] - s)
+                    totals["requests"] += 1
+            except AdmissionError as e:
+                # Only genuine 429 backpressure counts as a shed —
+                # deadline cancellations (504) and engine shutdown
+                # (503) are failures, not graceful load management.
+                key = "shed" if e.status == 429 else "timeouts"
+                with lock:
+                    totals[key] += 1
+                time.sleep(0.05)
+            except Exception:
+                with lock:
+                    totals["errors"] += 1
+
+    def sample_pool():
+        # ONE sampler thread, so the occupancy readout does not
+        # contend with the device thread's pool lock once per
+        # completed request across every stream.
+        pool = engine.kv_pool
+        while pool is not None and time.monotonic() < stop_at:
+            used = pool.occupancy()["blocks_used"]
+            with lock:
+                if used > totals["pool_peak"]:
+                    totals["pool_peak"] = used
+            time.sleep(0.05)
+
+    threads = [threading.Thread(target=stream, args=(i,),
+                                daemon=True)
+               for i in range(streams)]
+    sampler = threading.Thread(target=sample_pool, daemon=True)
+    t0 = time.monotonic()
+    sampler.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    totals["wall"] = time.monotonic() - t0
+    sampler.join(timeout=1.0)
+    return totals
+
+
+def serve_bench(argv):
+    import tempfile
+    from veles_tpu.export import ExportedModel
+    from veles_tpu.serving import ServingEngine
+    streams = SERVE_STREAMS
+    seconds = SERVE_SECONDS
+    for arg in argv:
+        if arg.startswith("--serve-streams="):
+            streams = int(arg.split("=", 1)[1])
+        elif arg.startswith("--serve-seconds="):
+            seconds = float(arg.split("=", 1)[1])
+    path = os.path.join(tempfile.gettempdir(),
+                        "veles_serve_bench.veles.tgz")
+    build_serve_artifact(path)
+
+    def one_mode(paged, kv_blocks=None):
+        from veles_tpu.serving import BucketPolicy
+        model = ExportedModel(path, compile_capacity=256)
+        engine = ServingEngine(
+            model, max_batch=SERVE_MAX_BATCH, queue_depth=streams,
+            default_deadline=max(30.0, seconds),
+            # batch_floor trims the warmup grid: under sustained
+            # ≥64-stream load, device batches below 8 rows are a
+            # transient, not a regime worth its own executable.
+            policy=BucketPolicy(max_batch=SERVE_MAX_BATCH,
+                                batch_floor=8,
+                                prompt_cap=SERVE_POS),
+            paged=paged, kv_blocks=kv_blocks,
+            kv_block_size=SERVE_KV_BLOCK)
+        engine.start()
+        try:
+            engine.warmup(
+                longest_prompt=max(SERVE_PROMPT_CHOICES),
+                max_new=max(SERVE_NEW_CHOICES))
+            totals = run_serve_load(engine, streams, seconds)
+            snap = engine.stats.snapshot()
+            pool = engine.kv_pool
+            occ = pool.occupancy() if pool is not None else {}
+        finally:
+            engine.stop()
+        return totals, snap, occ
+
+    # The paged pool is deliberately sized BELOW the worst case
+    # (max_batch full-length rows) so the soak drives it past
+    # exhaustion and exercises graceful 429 shedding.
+    per_row = -(-(max(SERVE_PROMPT_CHOICES) +
+                  max(SERVE_NEW_CHOICES)) // SERVE_KV_BLOCK)
+    kv_blocks = SERVE_MAX_BATCH * per_row * 3 // 4 + 1
+    paged_totals, paged_snap, occ = one_mode(True, kv_blocks)
+    dense_totals, _, _ = one_mode(False)
+    paged_tps = paged_totals["tokens"] / paged_totals["wall"]
+    dense_tps = dense_totals["tokens"] / \
+        max(dense_totals["wall"], 1e-9)
+
+    def pct(key, p):
+        lat = paged_snap["latency"].get(key) or {}
+        return lat.get("p%d_ms" % p)
+
+    print(json.dumps({
+        "metric": "serve_paged_decode_tok_per_sec",
+        "value": round(paged_tps, 1),
+        "unit": "tokens/sec",
+        # vs_baseline here is paged vs whole-request batching on the
+        # SAME workload — >1.0 means decode-step continuous batching
+        # sustains more aggregate throughput.
+        "vs_baseline": round(paged_tps / max(dense_tps, 1e-9), 4),
+        "vs_baseline_meaning": "paged_vs_whole_request_tok_per_sec",
+        "streams": streams,
+        "seconds": seconds,
+        "requests": paged_totals["requests"],
+        "shed_429": paged_totals["shed"],
+        "timeouts": paged_totals["timeouts"],
+        "errors": paged_totals["errors"],
+        "ttft_p50_ms": pct("ttft.generate", 50),
+        "ttft_p99_ms": pct("ttft.generate", 99),
+        "itl_p50_ms": pct("itl.decode", 50),
+        "itl_p99_ms": pct("itl.decode", 99),
+        "kv_blocks": kv_blocks,
+        "kv_pool_peak_blocks": paged_totals["pool_peak"],
+        "kv_prefix_hits": occ.get("prefix_hits"),
+        "kv_cow_copies": occ.get("cow_copies"),
+        "dense_tok_per_sec": round(dense_tps, 1),
+    }))
 
 
 def build_alexnet():
@@ -488,6 +738,9 @@ def measure(wf, epochs):
 
 
 def main():
+    if "--serve" in sys.argv:
+        serve_bench(sys.argv)
+        return
     if "--streamed-jpeg" in sys.argv:
         base = os.environ.get(
             "VELES_JPEG_DIR",

@@ -13,11 +13,17 @@ The contracts under test, per docs/serving.md:
   unserved;
 * /stats exposes queue depth, batch occupancy, compile-cache
   hits/misses, and latency percentiles;
-* batching buys ≥ 2× throughput over the serial handler.
+* batching buys ≥ 2× throughput over the serial handler;
+* paged decode (KVBlockPool + decode-step continuous batching) is
+  TOKEN-IDENTICAL to the dense bucketed path on a real artifact,
+  shares prompt prefixes with copy-on-write, sheds 429 on pool
+  exhaustion, carries pool geometry in its compile keys, ignores the
+  attention fast-path knobs, and sustains strictly higher aggregate
+  tok/s than whole-request batching on mixed-length streams.
 
-Everything runs on CPU with fake models except the parity test, which
-loads a small randomly-weighted LM artifact (no training — weights
-are handcrafted, so the test costs compiles, not epochs).
+Everything runs on CPU with fake models except the parity/prefix/knob
+tests, which load a small randomly-weighted LM artifact (no training —
+weights are handcrafted, so the tests cost compiles, not epochs).
 """
 
 import io
@@ -32,12 +38,13 @@ import numpy
 import pytest
 
 from veles_tpu.error import Bug
-from veles_tpu.export import ExportedModel
+from veles_tpu.export import ExportedModel, KVBlockPool
 from veles_tpu.resilience import Deadline
 from veles_tpu.serving import (BucketPolicy, CompileCache,
-                               DeadlineExceeded, QueueFull,
-                               RateLimited, RateLimiter,
-                               ServingEngine, TokenBucket, next_pow2)
+                               DeadlineExceeded, PoolExhausted,
+                               QueueFull, RateLimited, RateLimiter,
+                               ServingEngine, ServingStats,
+                               TokenBucket, next_pow2)
 
 
 # -- helpers ---------------------------------------------------------------
@@ -72,6 +79,11 @@ class FakeModel(object):
         # Per-row fingerprint: output depends only on the row.
         return x.sum(axis=1)[:, None] + numpy.arange(3)[None, :]
 
+    #: Per-decoded-token device cost (whole-request batching pays it
+    #: for the full DECODE BUCKET per batch — the padded-decode waste
+    #: continuous batching eliminates).
+    per_token_delay = 0.0
+
     def generate_bucketed(self, prompts, lengths, max_new,
                           temperatures, seeds):
         prompts = numpy.asarray(prompts)
@@ -81,12 +93,62 @@ class FakeModel(object):
                 (tuple(prompts.shape), int(max_new)))
         if self.delay:
             time.sleep(self.delay)
+        if self.per_token_delay:
+            time.sleep(self.per_token_delay * int(max_new))
         out = numpy.zeros((prompts.shape[0], int(max_new)),
                           numpy.int32)
         for i in range(prompts.shape[0]):
             last = int(prompts[i, int(lengths[i]) - 1])
             out[i] = (last + 1 + numpy.arange(int(max_new))) % 97
         return out
+
+
+class PagedFakeModel(object):
+    """Duck-typed PAGED serving model: the block-pool bookkeeping is
+    the real :class:`KVBlockPool` (device storage replaced by a
+    no-op), decode produces the same per-row fingerprint as
+    :class:`FakeModel` — token t = (last_prompt_token + 1 + t) % 97,
+    via tok+1 per step — and injectable per-call delays model device
+    economics: ``step_delay`` per decode step, ``prefill_delay`` per
+    extend call.  That makes scheduler properties (joins, immediate
+    retirement, aggregate tok/s) observable without XLA compiles."""
+
+    max_position = 64
+
+    def __init__(self, step_delay=0.0, prefill_delay=0.0):
+        self.step_delay = step_delay
+        self.prefill_delay = prefill_delay
+        self.extend_shapes = []  # (B, T, Sc)
+        self.step_shapes = []    # (B, T)
+        self._lock = threading.Lock()
+
+    def make_kv_pool(self, n_blocks, block_size=16):
+        return KVBlockPool(n_blocks, block_size,
+                           copy_fn=lambda storage, s, d: storage)
+
+    def paged_extend(self, pool, tables, tokens, prior, chunk_lens,
+                     temps, seeds):
+        tables = numpy.asarray(tables)
+        tokens = numpy.asarray(tokens)
+        clens = numpy.asarray(chunk_lens)
+        with self._lock:
+            self.extend_shapes.append(
+                tables.shape + (tokens.shape[1],))
+        if self.prefill_delay:
+            time.sleep(self.prefill_delay)
+        out = numpy.zeros(tokens.shape[0], numpy.int32)
+        for i in range(tokens.shape[0]):
+            out[i] = (int(tokens[i, max(int(clens[i]) - 1, 0)])
+                      + 1) % 97
+        return out
+
+    def paged_step(self, pool, tables, pos, tok, gen_idx, temps,
+                   seeds):
+        with self._lock:
+            self.step_shapes.append(numpy.asarray(tables).shape)
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        return (numpy.asarray(tok) + 1) % 97
 
 
 def _expected_forward(x):
@@ -673,7 +735,9 @@ def test_batched_throughput_at_least_2x_serial():
 @pytest.fixture(scope="module")
 def random_lm(tmp_path_factory):
     path = str(tmp_path_factory.mktemp("serving") / "rand.veles.tgz")
-    return ExportedModel(_random_lm_artifact(path))
+    model = ExportedModel(_random_lm_artifact(path))
+    model._test_artifact_path = path  # for fresh-load tests
+    return model
 
 
 def test_bucketed_generate_matches_unbucketed_greedy(random_lm):
@@ -834,3 +898,540 @@ def test_fwd_sentinels_evict_as_a_group(random_lm):
     assert not any(k and k[0] == "fwd"
                    for k in list(cache._entries))
     assert model._jit_forward is None
+
+
+# -- paged KV block pool (host-side accounting) ----------------------------
+
+
+def test_kv_block_pool_accounting():
+    copies = []
+    pool = KVBlockPool(8, 4, storage="S",
+                       copy_fn=lambda s, a, b: copies.append(
+                           (a, b)) or s)
+    assert pool.usable == 7  # block 0 is trash
+    assert pool.blocks_for(1) == 1 and pool.blocks_for(9) == 3
+    ids = pool.alloc(3)
+    assert len(ids) == 3 and KVBlockPool.TRASH not in ids
+    assert pool.free_count() == 4 and pool.used_count() == 3
+    pool.retain(ids[:1])
+    pool.release(ids)      # ids[0] still held by the extra ref
+    assert pool.free_count() == 6
+    pool.release(ids[:1])
+    assert pool.free_count() == 7
+    # Trash ids are ignored by retain/release (table padding).
+    pool.release([KVBlockPool.TRASH])
+    assert pool.free_count() == 7
+    # Over-ask fails cleanly — the caller sheds.
+    assert pool.alloc(8) is None
+    # COW copies through the model-supplied device copy.
+    a = pool.alloc(1)[0]
+    b = pool.cow_copy(a)
+    assert b != a and copies == [(a, b)]
+    assert pool.occupancy()["cow_copies"] == 1
+
+
+def test_kv_block_pool_prefix_cache_and_eviction():
+    pool = KVBlockPool(9, 4)
+    tokens = numpy.arange(10, dtype=numpy.int32)  # 2 full blocks
+    ids = pool.alloc(3)
+    pool.register_prefix(tokens, ids)
+    # Full-block granularity: prefixes of 1 and 2 blocks match, the
+    # partial tail does not ride the cache.
+    k, got = pool.lookup_prefix(tokens)
+    assert k == 2 and got == ids[:2]
+    pool.release(got)
+    k, got = pool.lookup_prefix(tokens[:7])  # 1 full block + tail
+    assert k == 1 and got == ids[:1]
+    pool.release(got)
+    k, got = pool.lookup_prefix(
+        numpy.arange(100, 110, dtype=numpy.int32))
+    assert k == 0 and got == []
+    occ = pool.occupancy()
+    assert occ["prefix_hits"] == 2 and occ["prefix_misses"] == 1
+    assert occ["prefix_entries"] == 2
+    # The cache holds refs: releasing the row's own refs keeps the
+    # blocks resident...
+    pool.release(ids)
+    assert pool.occupancy()["blocks_used"] == 2
+    # ...until allocation pressure evicts entries LRU-first — cached
+    # prompts are an optimization, never a reason to refuse traffic.
+    big = pool.alloc(8)
+    assert big is not None
+    assert pool.occupancy()["prefix_entries"] == 0
+
+
+# -- paged decode through the engine (real artifact) -----------------------
+
+
+def _paged_engine(model, **kw):
+    defaults = dict(max_batch=4, kv_blocks=32, kv_block_size=4)
+    defaults.update(kw)
+    return ServingEngine(model, **defaults)
+
+
+def test_paged_engine_greedy_matches_dense_bucketed(random_lm):
+    """THE acceptance gate: greedy decode through the paged path —
+    block tables, gather/scatter, continuous batching — is
+    TOKEN-IDENTICAL to the proven dense ``generate_bucketed``
+    program, on real attention, across coalesced rows of different
+    lengths."""
+    model = random_lm
+    rng = numpy.random.RandomState(7)
+    lengths = [2, 5, 8]
+    prompts = numpy.zeros((3, 8), numpy.int32)
+    rows = []
+    for i, length in enumerate(lengths):
+        p = rng.randint(0, 13, (1, length)).astype(numpy.int32)
+        prompts[i, :length] = p[0]
+        rows.append(p)
+    ref = model.generate_bucketed(prompts, lengths, 6)
+    engine = _paged_engine(model).start()
+    try:
+        assert engine.paged and engine.kv_pool is not None
+        out = {}
+
+        def gen(i):
+            out[i] = engine.submit_generate(rows[i], 6)
+
+        threads = [threading.Thread(target=gen, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, length in enumerate(lengths):
+            numpy.testing.assert_array_equal(
+                out[i][0, length:], ref[i])
+        # The decode ran through the paged surface, not the dense
+        # program: step batches executed and tokens were counted.
+        assert engine.stats.get("batches.decode") >= 1
+        assert engine.stats.get("tokens.generated") >= 18
+    finally:
+        engine.stop()
+
+
+def test_paged_prefix_reuse_and_cow(random_lm):
+    """A re-sent prompt adopts its cached blocks (prefilled ONCE) —
+    and because the whole prompt is cached, the first decode write
+    lands inside the last shared block, forcing a copy-on-write —
+    with output still token-identical to the dense path."""
+    model = random_lm
+    rng = numpy.random.RandomState(21)
+    prompt = rng.randint(0, 13, (1, 8)).astype(numpy.int32)
+    ref = model.generate_bucketed(prompt.copy(), [8], 6)
+    engine = _paged_engine(model).start()
+    try:
+        first = engine.submit_generate(prompt, 6)
+        numpy.testing.assert_array_equal(first[0, 8:], ref[0])
+        occ0 = engine.kv_pool.occupancy()
+        assert occ0["prefix_entries"] >= 1
+        second = engine.submit_generate(prompt, 6)
+        numpy.testing.assert_array_equal(second[0, 8:], ref[0])
+        occ1 = engine.kv_pool.occupancy()
+        assert occ1["prefix_hits"] >= occ0["prefix_hits"] + 1
+        assert occ1["cow_copies"] >= occ0["cow_copies"] + 1
+    finally:
+        engine.stop()
+
+
+def test_paged_pool_geometry_is_a_compile_key(random_lm):
+    """Flipping the pool's block size must reach a DIFFERENT
+    executable — a stale program compiled for another geometry would
+    scatter k/v into the wrong slots."""
+    model = random_lm
+    tokens = numpy.array([[3, 1, 4, 1]], numpy.int32)
+    outs = []
+    for bs in (4, 8):
+        pool = model.make_kv_pool(9, bs)
+        tables = numpy.zeros((1, 2), numpy.int32)
+        ids = pool.alloc(2)
+        tables[0, :2] = ids
+        tok0 = model.paged_extend(
+            pool, tables, tokens,
+            numpy.zeros(1, numpy.int32),
+            numpy.full(1, 4, numpy.int32),
+            numpy.zeros(1, numpy.float32),
+            numpy.zeros(1, numpy.uint32))
+        outs.append(int(tok0[0]))
+    pext_keys = {k for k in list(model.compile_cache._entries)
+                 if k and k[0] == "pext" and k[4] == 9}
+    assert len(pext_keys) == 2  # one per block size
+    assert {k[5] for k in pext_keys} == {4, 8}
+    # Same content, different layout — same first token.
+    assert outs[0] == outs[1]
+
+
+def test_paged_decode_ignores_fastpath_knobs(random_lm):
+    """PR-5 contract extended to the paged path: the paged programs
+    pin f32/XLA attention arithmetic, so flipping the attention
+    fast-path knobs in the process must not change a single decoded
+    token."""
+    from veles_tpu.config import root
+    model = random_lm
+    prompt = numpy.array([[7, 3, 1, 4, 1]], numpy.int32)
+    ref = model.generate_bucketed(
+        numpy.pad(prompt, ((0, 0), (0, 3))), [5], 4)
+    root.common.engine.attention_dtype = "bf16"
+    root.common.engine.attention_kernel = "auto"
+    try:
+        # A FRESH model: its paged programs trace under the flipped
+        # knobs — deployed bits must still be identical.
+        flipped = ExportedModel(model._test_artifact_path)
+        engine = _paged_engine(flipped).start()
+        try:
+            out = engine.submit_generate(prompt, 4)
+            numpy.testing.assert_array_equal(out[0, 5:], ref[0])
+        finally:
+            engine.stop()
+    finally:
+        root.common.engine.attention_dtype = "f32"
+        root.common.engine.attention_kernel = "xla"
+
+
+# -- paged decode scheduling (fake model, no compiles) ---------------------
+
+
+def test_paged_continuous_batching_beats_whole_request():
+    """The tier-1 loopback acceptance gate: on mixed decode budgets,
+    whole-request batching pays the padded decode bucket per group
+    and serializes incompatible groups, while decode-step continuous
+    batching runs exactly the needed steps with every stream riding
+    one batch — strictly higher aggregate tok/s, same per-token
+    device cost."""
+    delay = 0.01
+    needs = [3, 5, 9, 17, 20, 31]
+    prompts = [numpy.array([[5, 7, 9, 11]], numpy.int32)
+               for _ in needs]
+
+    def drive(engine):
+        outs = [None] * len(needs)
+
+        def gen(i):
+            outs[i] = engine.submit_generate(prompts[i], needs[i])
+
+        threads = [threading.Thread(target=gen, args=(i,))
+                   for i in range(len(needs))]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        for i, n in enumerate(needs):
+            numpy.testing.assert_array_equal(
+                outs[i][0, 4:], _expected_generated(prompts[i][0], n))
+        return sum(needs) / wall
+
+    dense_model = FakeModel()
+    dense_model.per_token_delay = delay
+    dense = ServingEngine(
+        dense_model, max_batch=8,
+        policy=BucketPolicy(max_batch=8, new_floor=4)).start()
+    try:
+        dense_tps = drive(dense)
+    finally:
+        dense.stop()
+
+    paged = ServingEngine(
+        PagedFakeModel(step_delay=delay), max_batch=8,
+        kv_blocks=64, kv_block_size=8,
+        policy=BucketPolicy(max_batch=8, new_floor=4)).start()
+    try:
+        paged_tps = drive(paged)
+    finally:
+        paged.stop()
+    # Dense pays bucketed decode steps per (serialized) group:
+    # buckets 4+8+16+32 = 60 device steps for 85 real tokens; paged
+    # pays ~32 steps total with every row coalesced.  Strictly
+    # higher, with margin for scheduler jitter.
+    assert paged_tps > dense_tps * 1.15, \
+        "paged %.1f tok/s vs dense %.1f tok/s" % (paged_tps,
+                                                  dense_tps)
+
+
+def test_paged_rows_join_and_retire_mid_flight():
+    """Iteration-level scheduling: a short request submitted while a
+    long one is mid-decode joins the RUNNING batch (no whole-request
+    boundary) and retires ahead of it, freeing its blocks
+    immediately."""
+    model = PagedFakeModel(step_delay=0.02)
+    engine = ServingEngine(model, max_batch=4, kv_blocks=33,
+                           kv_block_size=8).start()
+    try:
+        done = {}
+
+        def long_req():
+            out = engine.submit_generate(
+                numpy.array([[9, 9, 9]], numpy.int32), 40)
+            done["long"] = time.monotonic()
+            done["long_out"] = out
+
+        t_long = threading.Thread(target=long_req)
+        t_long.start()
+        time.sleep(0.2)  # the long request is decoding by now
+        short_out = engine.submit_generate(
+            numpy.array([[5, 7]], numpy.int32), 3)
+        done["short"] = time.monotonic()
+        t_long.join()
+        assert done["short"] < done["long"]
+        numpy.testing.assert_array_equal(
+            short_out[0, 2:],
+            _expected_generated(numpy.array([5, 7]), 3))
+        numpy.testing.assert_array_equal(
+            done["long_out"][0, 3:],
+            _expected_generated(numpy.array([9, 9, 9]), 40))
+        # 40 tokens = 1 from prefill + 39 decode steps; the short
+        # request rode those same steps rather than its own batch.
+        assert engine.stats.get("batches.decode") >= 39
+    finally:
+        engine.stop()
+
+
+def test_paged_pool_exhaustion_sheds_429():
+    """Admission control under paged decode sheds on the BLOCK POOL,
+    not the queue: a request whose worst-case block need does not
+    fit on top of existing commitments is refused 429 with a
+    Retry-After derived from the running batch's retirement
+    horizon."""
+    model = PagedFakeModel(step_delay=0.03)
+    engine = ServingEngine(model, max_batch=4, kv_blocks=9,
+                           kv_block_size=8).start()
+    try:
+        blocker = threading.Thread(
+            target=engine.submit_generate,
+            args=(numpy.array([[1] * 8], numpy.int32), 40))
+        blocker.start()
+        time.sleep(0.15)  # 6 of 8 usable blocks committed
+        with pytest.raises(PoolExhausted) as e:
+            engine.submit_generate(
+                numpy.array([[2] * 8], numpy.int32), 40)
+        assert e.value.status == 429
+        assert e.value.retry_after is not None
+        assert engine.stats.get("rejected.pool_exhausted") == 1
+        blocker.join()
+        # A request that can NEVER fit is a client/config error, not
+        # a retry-later.
+        with pytest.raises(Bug, match="KV blocks"):
+            engine.submit_generate(
+                numpy.tile(numpy.array([[3] * 8], numpy.int32),
+                           (2, 1)), 40)
+    finally:
+        engine.stop()
+
+
+def test_paged_queue_depth_still_backstops():
+    """The pool is the primary shed point, but --queue-depth stays
+    live on the paged path as the payload-memory backstop: tiny
+    requests on a big pool must not park unbounded handler
+    threads."""
+    model = PagedFakeModel(step_delay=0.05)
+    engine = ServingEngine(model, max_batch=1, queue_depth=1,
+                           kv_blocks=65, kv_block_size=8).start()
+    try:
+        prompt = numpy.array([[1, 2]], numpy.int32)
+        first = threading.Thread(
+            target=engine.submit_generate, args=(prompt, 20))
+        first.start()
+        time.sleep(0.15)  # adopted into the decode batch by now
+        second = threading.Thread(
+            target=lambda: engine.submit_generate(prompt, 20))
+        second.start()
+        time.sleep(0.15)  # waiting for adoption: queue at depth
+        with pytest.raises(QueueFull) as e:
+            engine.submit_generate(prompt, 20)
+        assert e.value.status == 429
+        assert engine.stats.get("rejected.queue_full") == 1
+        first.join()
+        second.join()
+    finally:
+        engine.stop()
+
+
+def test_paged_deadline_cancels_mid_decode():
+    """A deadline expiring MID-DECODE retires the request's rows and
+    frees their blocks — a hung client cannot squat on the pool."""
+    model = PagedFakeModel(step_delay=0.05)
+    engine = ServingEngine(model, max_batch=4, kv_blocks=17,
+                           kv_block_size=8).start()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            engine.submit_generate(
+                numpy.array([[1, 2, 3]], numpy.int32), 60,
+                deadline=Deadline(0.3))
+        deadline_wait = time.monotonic()
+        while engine.kv_pool.occupancy()["blocks_used"] and \
+                time.monotonic() - deadline_wait < 5.0:
+            time.sleep(0.02)
+        occ = engine.kv_pool.occupancy()
+        assert occ["blocks_used"] == 0  # blocks freed on cancel
+    finally:
+        engine.stop()
+
+
+def test_serve_load_tiny_paged():
+    """Tier-1 micro-soak (the 64-stream bench.py --serve soak is
+    marked slow): 4 concurrent streams of 8-token decodes through
+    the paged engine, with the operator metrics the soak reports —
+    tok/s, TTFT/ITL windows, pool gauges — all live."""
+    model = PagedFakeModel(step_delay=0.002)
+    engine = ServingEngine(model, max_batch=4, kv_blocks=17,
+                           kv_block_size=8).start()
+    try:
+        def stream(idx):
+            for _ in range(2):
+                p = numpy.array([[idx + 1, idx + 2]], numpy.int32)
+                out = engine.submit_generate(p, 8)
+                numpy.testing.assert_array_equal(
+                    out[0, 2:], _expected_generated(p[0], 8))
+
+        threads = [threading.Thread(target=stream, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = engine.stats.snapshot()
+        assert snap["decode_tok_per_sec"] > 0
+        assert snap["counters"]["tokens.generated"] == 64
+        assert snap["latency"]["ttft.generate"]["count"] == 8
+        assert snap["latency"]["itl.decode"]["p50_ms"] is not None
+        assert snap["gauges"]["kv_blocks_total"] == 16
+        assert snap["gauges"]["kv_blocks_used"] == 0  # all retired
+    finally:
+        engine.stop()
+
+
+@pytest.mark.slow
+def test_serve_soak_64_streams():
+    """The ≥64-stream soak (slow tier): mixed prompt/decode
+    geometry, a pool deliberately too small for the worst case, ~3
+    seconds of sustained load — every completed request is
+    token-correct, shedding is graceful 429 (no other errors), and
+    the live stats carry the soak's numbers."""
+    model = PagedFakeModel(step_delay=0.001)
+    engine = ServingEngine(model, max_batch=32, kv_blocks=129,
+                           kv_block_size=8,
+                           default_deadline=60.0).start()
+    stop_at = time.monotonic() + 3.0
+    totals = {"tokens": 0, "requests": 0, "shed": 0, "errors": 0}
+    lock = threading.Lock()
+
+    def stream(idx):
+        rng = numpy.random.RandomState(idx)
+        while time.monotonic() < stop_at:
+            s = int(rng.choice([2, 5, 8, 13]))
+            m = int(rng.choice([4, 8, 16, 32]))
+            p = rng.randint(0, 90, (1, s)).astype(numpy.int32)
+            try:
+                out = engine.submit_generate(p, m)
+                numpy.testing.assert_array_equal(
+                    out[0, s:], _expected_generated(p[0], m))
+                with lock:
+                    totals["tokens"] += m
+                    totals["requests"] += 1
+            except PoolExhausted:
+                with lock:
+                    totals["shed"] += 1
+                time.sleep(0.01)
+            except Exception:
+                with lock:
+                    totals["errors"] += 1
+
+    threads = [threading.Thread(target=stream, args=(i,))
+               for i in range(64)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    engine.stop()
+    assert totals["errors"] == 0
+    assert totals["requests"] >= 64
+    assert totals["shed"] >= 1  # the pool IS the limiter
+    assert totals["tokens"] > 0
+
+
+# -- satellite: per-kind drain estimates -----------------------------------
+
+
+def test_drain_estimate_is_per_kind():
+    """A multi-second generate batch must not poison the Retry-After
+    quoted to a cheap classify flood: the estimate mixes per-kind
+    EWMAs by the queue's actual composition."""
+    from veles_tpu.serving.engine import _Request
+    engine = ServingEngine(FakeModel(), max_batch=4)
+    engine._batch_ewma = {"classify": 0.02, "generate": 8.0}
+    for _ in range(8):
+        engine._pending.append(_Request("classify", ("c",), 1, None))
+    # 8 classify = 2 batches x 0.02s -> floors at 1s, NOT 2x8s.
+    assert engine._drain_estimate_locked() == 1.0
+    for _ in range(4):
+        engine._pending.append(_Request("generate", ("g",), 1, None))
+    # ...but queued generate work IS quoted at generate cost.
+    est = engine._drain_estimate_locked()
+    assert 8.0 <= est <= 9.0
+
+
+# -- satellite: end-to-end deadlines across chunks -------------------------
+
+
+def test_chunked_request_deadline_fails_fast():
+    """An oversized request splits into sequential chunks that all
+    share the ORIGINAL deadline — a nearly-expired budget fails fast
+    with zero device work instead of half-generating."""
+    model = FakeModel()
+    engine = ServingEngine(model, max_batch=2).start()
+    try:
+        deadline = Deadline(1e-9)
+        time.sleep(0.01)
+        prompts = numpy.tile(numpy.array([[3, 1, 4]], numpy.int32),
+                             (6, 1))
+        with pytest.raises(DeadlineExceeded):
+            engine.submit_generate(prompts, 2, deadline=deadline)
+        time.sleep(0.05)
+        assert model.gen_shapes == []  # no device call at all
+        assert engine.stats.get("cancelled.deadline") >= 1
+        # Same contract on the classify split path.
+        with pytest.raises(DeadlineExceeded):
+            engine.submit_classify(
+                numpy.zeros((6, 4), numpy.float32),
+                deadline=Deadline(1e-9))
+        assert model.forward_shapes == []
+    finally:
+        engine.stop()
+
+
+# -- satellite: stats gauges + token rate ----------------------------------
+
+
+def test_stats_gauges_and_token_rate():
+    stats = ServingStats()
+    stats.set_gauge("kv_blocks_used", 12)
+    stats.note_tokens(30)
+    stats.observe_latency("ttft.generate", 0.25)
+    stats.observe_latency("itl.decode", 0.005)
+    snap = stats.snapshot()
+    assert snap["gauges"]["kv_blocks_used"] == 12
+    assert snap["decode_tok_per_sec"] > 0
+    assert snap["latency"]["ttft.generate"]["p50_ms"] == 250.0
+    assert snap["latency"]["itl.decode"]["count"] == 1
+
+
+def test_stats_endpoint_reports_kv_pool():
+    """/stats carries the pool occupancy section when the engine
+    serves paged."""
+    from veles_tpu.restful import ModelServer
+    server = ModelServer(PagedFakeModel(), host="127.0.0.1", port=0,
+                         max_batch=2, kv_blocks=9,
+                         kv_block_size=8).start()
+    try:
+        status, _, _ = _post(server.port, "/api/generate",
+                             {"tokens": [[1, 2, 3]],
+                              "max_new_tokens": 4})
+        assert status == 200
+        status, stats = _get(server.port, "/stats")
+        assert status == 200
+        assert stats["kv_pool"]["blocks_total"] == 8
+        assert stats["kv_pool"]["block_size"] == 8
+        assert "decode_tok_per_sec" in stats
+    finally:
+        server.stop()

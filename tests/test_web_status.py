@@ -52,6 +52,49 @@ def test_update_and_dashboard(status_server):
     assert "w/1" in page
 
 
+def test_dashboard_renders_serving_row(status_server):
+    """A heartbeat carrying a ``serving`` section (an in-process
+    ServingEngine's tok/s + KV-pool occupancy) gets its own row —
+    the soak's numbers as live operator metrics."""
+    _post(status_server.port, "/update", {
+        "id": "m-serve", "workflow": "ServeWorkflow",
+        "serving": {"engines": 1, "tok_per_sec": 1234.5,
+                    "kv_blocks_used": 40, "kv_blocks_total": 64,
+                    "queue_depth": 2},
+    })
+    page = _get(status_server.port, "/")
+    assert "serving" in page
+    assert "1234.5" in page
+    assert "kv_blocks_used" in page
+
+
+def test_live_serving_summary_aggregates_engines():
+    """The heartbeat's serving section comes from the weak live-
+    engine registry: a started engine is visible, a stopped one
+    drops out."""
+    import numpy
+    from veles_tpu.serving import ServingEngine
+    from veles_tpu.serving.metrics import live_serving_summary
+
+    class M(object):
+        max_position = None
+
+        def forward(self, x):
+            return numpy.asarray(x)
+
+    engine = ServingEngine(M(), max_batch=2)
+    assert live_serving_summary() is None  # not started: invisible
+    engine.start()
+    try:
+        summary = live_serving_summary()
+        assert summary is not None
+        assert summary["engines"] >= 1
+        assert "tok_per_sec" in summary
+    finally:
+        engine.stop()
+    assert live_serving_summary() is None
+
+
 def test_service_command_roundtrip(status_server):
     _post(status_server.port, "/update", {"id": "m2",
                                           "workflow": "X"})

@@ -287,6 +287,13 @@ class Main(Logger, CommandLineBase):
             root.common.serving.token = args.serve_token
         if args.serve_warmup:
             root.common.serving.warmup = True
+        if args.serve_kv_blocks is not None:
+            root.common.serving.kv_blocks = args.serve_kv_blocks
+        if args.serve_kv_block_size is not None:
+            root.common.serving.kv_block_size = \
+                args.serve_kv_block_size
+        if args.serve_no_paged:
+            root.common.serving.paged = False
         # Attention fast-path knobs (ops/attention.init_parser;
         # docs/attention.md) — read back at unit construction
         # (fused_qkv freezes the parameter layout) and inside the

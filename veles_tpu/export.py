@@ -20,10 +20,13 @@ chain is serialized to a versioned tar.gz holding
 the native runtime.
 """
 
+import collections
+import hashlib
 import io
 import json
 import struct
 import tarfile
+import threading
 import time
 
 import numpy
@@ -319,6 +322,253 @@ def _pack_binary(manifest, weight_arrays):
             out.append(numpy.ascontiguousarray(
                 arr, dtype=numpy.float32).tobytes())
     return b"".join(out)
+
+
+# -- paged KV cache: the block pool --------------------------------------
+
+class KVBlockPool(object):
+    """A vLLM-style block pool for the paged serving decode path:
+    the device holds one fixed tensor of ``(n_blocks, block_size, H,
+    D)`` k/v blocks per layer (``storage``, owned by the model that
+    built the pool), and every request addresses it through a
+    per-request BLOCK TABLE of physical block ids — so N concurrent
+    streams of wildly different lengths share one allocation instead
+    of each owning a dense ``(B, L, H, D)`` cache sized to its max.
+
+    This object is the HOST-side half: block accounting (free list +
+    per-block refcounts), the prompt-prefix cache (full-block
+    prefixes keyed by token hash, LRU-bounded, each entry holding a
+    ref on its blocks so a common system prompt stays resident and
+    is prefilled ONCE), and copy-on-write (a row about to WRITE into
+    a shared block gets a private copy first).  Device tensors are
+    opaque here — the owning model supplies ``copy_fn(storage, src,
+    dst) -> storage`` and mutates ``storage`` through its own jitted
+    gather/scatter programs.
+
+    Block 0 is the TRASH block: table padding and out-of-range
+    writes land there, so padded rows in a coalesced device batch
+    can scatter junk without owning real blocks.  Accounting is
+    lock-guarded: the engine's device thread allocates/frees while
+    HTTP threads read ``occupancy()`` for ``/stats``.
+    """
+
+    TRASH = 0
+
+    def __init__(self, n_blocks, block_size, storage=None,
+                 copy_fn=None, prefix_capacity=256):
+        n_blocks = int(n_blocks)
+        block_size = int(block_size)
+        if n_blocks < 2:
+            raise Bug("a KV block pool needs >= 2 blocks (block 0 "
+                      "is the trash block), got %d" % n_blocks)
+        if block_size < 1:
+            raise Bug("block_size must be >= 1, got %d" % block_size)
+        self.n_blocks = n_blocks
+        self.block_size = block_size
+        self.storage = storage
+        self._copy_fn = copy_fn
+        self.prefix_capacity = int(prefix_capacity)
+        self._lock = threading.Lock()
+        # LIFO free list: recently-freed blocks are re-used first
+        # (their pages are warm).  Block 0 (trash) is never free.
+        self._free = list(range(n_blocks - 1, 0, -1))
+        self._refs = {}
+        # digest -> tuple(block ids); OrderedDict as LRU (most
+        # recently hit last).  Entries hold one ref per block.
+        self._prefix = collections.OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.cow_copies = 0
+
+    @property
+    def usable(self):
+        """Blocks available to requests (total minus trash)."""
+        return self.n_blocks - 1
+
+    def free_count(self):
+        with self._lock:
+            return len(self._free)
+
+    def used_count(self):
+        with self._lock:
+            return self.usable - len(self._free)
+
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold ``n_tokens`` cache slots."""
+        return -(-int(n_tokens) // self.block_size)
+
+    # -- allocation ------------------------------------------------------
+
+    def alloc(self, n):
+        """``n`` fresh block ids (ref 1 each), or None when the pool
+        cannot supply them even after evicting cached prefixes —
+        the caller sheds load.  Prefix entries are evicted LRU-first
+        under pressure: cached prompts are an optimization, never a
+        reason to refuse live traffic."""
+        n = int(n)
+        with self._lock:
+            while len(self._free) < n and self._prefix:
+                _, ids = self._prefix.popitem(last=False)
+                self._release_locked(ids)
+            if len(self._free) < n:
+                return None
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._refs[b] = 1
+            return out
+
+    def retain(self, ids):
+        """Adds one ref per block — the generic counterpart of
+        :meth:`release` for callers that hand a table to a second
+        owner (``lookup_prefix``/``register_prefix`` take their own
+        refs internally)."""
+        with self._lock:
+            for b in ids:
+                if b == self.TRASH:
+                    continue
+                self._refs[b] += 1
+
+    def release(self, ids):
+        """Drops one ref per block; blocks at zero return to the
+        free list.  Trash ids are ignored (table padding)."""
+        with self._lock:
+            self._release_locked(ids)
+
+    def _release_locked(self, ids):
+        for b in ids:
+            if b == self.TRASH:
+                continue
+            left = self._refs[b] - 1
+            if left:
+                self._refs[b] = left
+            else:
+                del self._refs[b]
+                self._free.append(b)
+
+    # -- prefix sharing --------------------------------------------------
+
+    def prefix_chain(self, tokens):
+        """Chained per-block digests (digest_j = sha1(digest_{j-1} ·
+        block_j tokens), the vLLM scheme): O(L) total hashing for
+        every full-block prefix of a prompt, computed OUTSIDE the
+        pool lock so adoption never blocks ``occupancy()`` readers
+        on hashing.  Callers doing lookup-then-register pass the
+        same chain to both so each prompt is hashed ONCE."""
+        tokens = numpy.ascontiguousarray(tokens, dtype=numpy.int32)
+        bs = self.block_size
+        chain = []
+        digest = b""
+        for j in range(len(tokens) // bs):
+            digest = hashlib.sha1(
+                digest + tokens[j * bs:(j + 1) * bs].tobytes()
+            ).digest()
+            chain.append(digest)
+        return chain
+
+    def lookup_prefix(self, tokens, chain=None):
+        """The longest cached full-block prefix of ``tokens``:
+        ``(n_full_blocks_matched, block_ids)`` with one ref per
+        returned block ALREADY TAKEN for the caller, or ``(0, [])``.
+        Matching is by token-content hash at full-block granularity
+        — a request sharing a system prompt adopts its blocks
+        instead of re-prefilling them."""
+        if chain is None:
+            chain = self.prefix_chain(tokens)
+        with self._lock:
+            for j in range(len(chain), 0, -1):
+                ids = self._prefix.get(chain[j - 1])
+                if ids is None:
+                    continue
+                self._prefix.move_to_end(chain[j - 1])
+                for b in ids:
+                    self._refs[b] += 1
+                self.prefix_hits += 1
+                return j, list(ids)
+            self.prefix_misses += 1
+            return 0, []
+
+    def register_prefix(self, tokens, block_ids, chain=None):
+        """Registers every full-block prefix of a just-prefilled
+        prompt (``block_ids`` = its table, position-ordered) so later
+        requests can adopt the blocks.  Existing entries are kept
+        (their blocks already hold the same content); the LRU bound
+        evicts the coldest entries past ``prefix_capacity``."""
+        if chain is None:
+            chain = self.prefix_chain(tokens)
+        with self._lock:
+            for j, key in enumerate(chain, start=1):
+                if key in self._prefix:
+                    self._prefix.move_to_end(key)
+                    continue
+                ids = tuple(block_ids[:j])
+                for b in ids:
+                    self._refs[b] += 1
+                self._prefix[key] = ids
+            while len(self._prefix) > self.prefix_capacity:
+                _, ids = self._prefix.popitem(last=False)
+                self._release_locked(ids)
+
+    # -- copy-on-write ---------------------------------------------------
+
+    def cow_copy(self, block_id):
+        """Copy-on-write: a fresh private block holding a device copy
+        of ``block_id``'s content (the caller is about to WRITE into
+        a position that falls inside a shared block — e.g. a fully
+        prefix-cached prompt re-feeding its last token).  The caller
+        keeps responsibility for releasing its ref on the shared
+        original.  Returns the new id, or None when the pool is
+        exhausted."""
+        ids = self.alloc(1)
+        if ids is None:
+            return None
+        if self._copy_fn is not None:
+            self.storage = self._copy_fn(self.storage, int(block_id),
+                                         int(ids[0]))
+        with self._lock:
+            self.cow_copies += 1
+        return ids[0]
+
+    # -- observability ---------------------------------------------------
+
+    def occupancy(self):
+        """The ``/stats`` pool section: block occupancy plus prefix-
+        cache and COW counters."""
+        with self._lock:
+            return {
+                "block_size": self.block_size,
+                "blocks_total": self.usable,
+                "blocks_free": len(self._free),
+                "blocks_used": self.usable - len(self._free),
+                "prefix_entries": len(self._prefix),
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "cow_copies": self.cow_copies,
+            }
+
+
+# -- shared LM decode helpers -------------------------------------------
+# ONE implementation of the head projection and the per-row
+# greedy/temperature select, shared by the dense bucketed programs and
+# the paged extend/step programs: a sampling fix applied to one copy
+# but not another would silently break the documented bit-identical
+# greedy guarantee between the two paths.
+
+def _head_logits(x_last, head_w, head_b):
+    y = x_last @ head_w
+    return y + head_b if head_b is not None else y
+
+
+def _sample_rows(logits, keys, temps):
+    """Greedy/temperature select per row; temperatures are TRACED
+    (never a compile key) and each row draws from its own PRNG
+    stream."""
+    import jax
+    import jax.numpy as jnp
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(
+        keys, scaled).astype(jnp.int32)
+    return jnp.where(temps > 0.0, sampled, greedy)
 
 
 # -- execution from the artifact ----------------------------------------
@@ -920,8 +1170,7 @@ class ExportedModel(object):
             return emb_w[t] + pos
 
         def logits_of(x_last):
-            y = x_last @ head_w
-            return y + head_b if head_b is not None else y
+            return _head_logits(x_last, head_w, head_b)
 
         def sample(logits, key, temperature):
             """Greedy/temperature select with temperature as a TRACED
@@ -1093,15 +1342,9 @@ class ExportedModel(object):
         V = emb_w.shape[0]
 
         def logits_of(x_last):
-            y = x_last @ head_w
-            return y + head_b if head_b is not None else y
+            return _head_logits(x_last, head_w, head_b)
 
-        def sample_rows(logits, keys, temps):
-            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-            sampled = jax.vmap(jax.random.categorical)(
-                keys, scaled).astype(jnp.int32)
-            return jnp.where(temps > 0.0, sampled, greedy)
+        sample_rows = _sample_rows
 
         def run(prompts, lengths, seeds, temps):
             B = prompts.shape[0]
@@ -1206,6 +1449,275 @@ class ExportedModel(object):
             ("genb", B, S0b, max_new),
             lambda: self._build_generate_bucketed(S0b, max_new))
         return numpy.asarray(fn(prompts, lengths, seeds, temps))
+
+    # ---- paged serving decode (block-pool KV cache) -------------------
+
+    def _paged_geometry(self):
+        """(n_layers, n_heads, head_dim) of the LM chain, or Bug —
+        the paged pool stacks every layer's blocks in one per-layer
+        tensor list, so the head geometry must be uniform."""
+        emb, blocks, _ = self._lm_chain()
+        heads = {int(e["config"]["n_heads"]) for e in blocks}
+        if len(heads) != 1:
+            raise Bug("paged decode requires a uniform head count "
+                      "across blocks, got %s" % sorted(heads))
+        H = heads.pop()
+        E = int(self.weights[emb["params"]["weights"]].shape[1])
+        if E % H:
+            raise Bug("embed dim %d not divisible by %d heads" %
+                      (E, H))
+        return len(blocks), H, E // H
+
+    def make_kv_pool(self, n_blocks, block_size=16):
+        """A :class:`KVBlockPool` backed by per-layer device tensors
+        of ``(n_blocks, block_size, H, D)`` k/v blocks — the paged
+        substrate the serving engine's decode-step batching runs on.
+        Raises Bug when the artifact is not a causal LM."""
+        import jax.numpy as jnp
+        L, H, D = self._paged_geometry()
+        ks = [jnp.zeros((int(n_blocks), int(block_size), H, D),
+                        jnp.float32) for _ in range(L)]
+        vs = [jnp.zeros((int(n_blocks), int(block_size), H, D),
+                        jnp.float32) for _ in range(L)]
+        return KVBlockPool(n_blocks, block_size, storage=(ks, vs),
+                           copy_fn=self._kv_copy_block)
+
+    def _kv_copy_block(self, storage, src, dst):
+        """Device-side block copy for the pool's copy-on-write (one
+        jitted program per pool geometry; src/dst are traced, so
+        every copy rides the same executable)."""
+        import jax
+        ks, vs = storage
+        key = ("pcopy", ks[0].shape[0], ks[0].shape[1], len(ks))
+
+        def build():
+            def run(ks, vs, src, dst):
+                ks = [k.at[dst].set(k[src]) for k in ks]
+                vs = [v.at[dst].set(v[src]) for v in vs]
+                return ks, vs
+            return jax.jit(run, donate_argnums=(0, 1))
+
+        fn = self.compile_cache.get_or_build(key, build)
+        return fn(ks, vs, numpy.int32(src), numpy.int32(dst))
+
+    def _paged_block(self, p, x, pk, pv, tables, wblock, wslot,
+                     key_mask, n_heads):
+        """One pre-LN block against the POOLED cache: the chunk's
+        k/v scatter to ``(wblock, wslot)`` (physical block, in-block
+        slot — per row AND per chunk position, so rows at different
+        sequence positions coexist in one static-shape batch), then
+        the whole table is gathered back ``(B, T·bs, H, D)`` and
+        queries attend it under ``key_mask``.  Same arithmetic as
+        :meth:`_cached_block` — masked slots are exact zeros after
+        softmax and real keys keep their relative order, so paged
+        greedy decode is bit-identical to the dense cached path."""
+        import jax
+        import jax.numpy as jnp
+
+        def ln(v, g, b, eps=1e-5):
+            mu = v.mean(axis=-1, keepdims=True)
+            var = ((v - mu) ** 2).mean(axis=-1, keepdims=True)
+            return (v - mu) * jnp.reciprocal(jnp.sqrt(var + eps)) \
+                * g + b
+
+        B, S_, E = x.shape
+        H = n_heads
+        D = E // H
+        h = ln(x, p["ln1_g"], p["ln1_b"])
+        if "wqkv" in p:
+            qkv = (h @ p["wqkv"] + p["bqkv"]).reshape(B, S_, H, 3, D)
+            q, kn, vn = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        else:
+            q = (h @ p["wq"] + p["bq"]).reshape(B, S_, H, D)
+            kn = (h @ p["wk"] + p["bk"]).reshape(B, S_, H, D)
+            vn = (h @ p["wv"] + p["bv"]).reshape(B, S_, H, D)
+        pk = pk.at[wblock, wslot].set(kn)
+        pv = pv.at[wblock, wslot].set(vn)
+        kc = pk[tables].reshape(B, -1, H, D)
+        vc = pv[tables].reshape(B, -1, H, D)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bqhk", q, kc,
+            preferred_element_type=jnp.float32) / (D ** 0.5)
+        scores = jnp.where(key_mask[:, :, None, :], scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bqhk,bkhd->bqhd", w, vc).reshape(B, S_, E)
+        x = x + attn @ p["wo"] + p["bo"]
+        h = ln(x, p["ln2_g"], p["ln2_b"])
+        x = x + jnp.maximum(h @ p["w1"] + p["b1"], 0.0) @ p["w2"] \
+            + p["b2"]
+        return x.astype(jnp.float32), pk, pv
+
+    def _paged_lm_tables(self):
+        """Shared (embed/head/block param) pieces of the paged
+        programs, as jnp-ready arrays."""
+        import jax.numpy as jnp
+        emb, blocks, head = self._lm_chain()
+        emb_w = jnp.asarray(self.weights[emb["params"]["weights"]])
+        emb_pos = jnp.asarray(self.weights[emb["params"]["pos"]])
+        head_w = self.weights[head["params"]["weights"]]
+        head_b = self.weights[head["params"]["bias"]] \
+            if "bias" in head["params"] else None
+        block_params = [
+            {n: self.weights[e["params"][n]] for n in e["params"]}
+            for e in blocks]
+        n_heads = [int(e["config"]["n_heads"]) for e in blocks]
+        return emb_w, emb_pos, head_w, head_b, block_params, n_heads
+
+    def _build_paged_extend(self, Sc, T, block_size):
+        """Jitted chunk prefill/extension against the block pool:
+        each row's ``chunk_len`` real tokens (right-padded to the
+        ``Sc`` bucket) are embedded at logical positions ``prior +
+        i``, their k/v scattered into the row's table blocks, and
+        the chunk attends the pool causally over absolute positions
+        — ``prior = 0`` is a fresh prefill, ``prior = k·bs`` extends
+        a shared prefix of k cached blocks, and a single-token chunk
+        at ``prior = len-1`` re-derives the first logits of a fully
+        prefix-cached prompt.  Returns the sampled first generated
+        token per row (PRNG fold index 0, matching the bucketed
+        path's stream)."""
+        import jax
+        import jax.numpy as jnp
+        emb_w, emb_pos, head_w, head_b, block_params, n_heads = \
+            self._paged_lm_tables()
+        P = emb_pos.shape[0]
+        V = emb_w.shape[0]
+        bs = int(block_size)
+        S_keys = T * bs
+
+        def logits_of(x_last):
+            return _head_logits(x_last, head_w, head_b)
+
+        sample_rows = _sample_rows
+
+        def run(pks, pvs, tables, tokens, prior, chunk_len, temps,
+                seeds):
+            B = tables.shape[0]
+            keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
+            offs = jnp.arange(Sc)
+            # Logical positions (clipped: pad columns past the table
+            # read junk that is never unmasked).
+            posn = jnp.clip(prior[:, None] + offs[None, :], 0, P - 1)
+            t = jnp.clip(tokens.astype(jnp.int32), 0, V - 1)
+            x = emb_w[t] + jnp.take(emb_pos, posn, axis=0)
+            wpos = jnp.clip(prior[:, None] + offs[None, :], 0,
+                            S_keys - 1)
+            wblock = jnp.take_along_axis(tables, wpos // bs, axis=1)
+            wslot = wpos % bs
+            qpos = prior[:, None] + offs[None, :]
+            key_mask = (jnp.arange(S_keys)[None, None, :] <=
+                        qpos[:, :, None])
+            new_pks, new_pvs = [], []
+            for pk, pv, p, H in zip(pks, pvs, block_params, n_heads):
+                x, pk, pv = self._paged_block(
+                    p, x, pk, pv, tables, wblock, wslot, key_mask, H)
+                new_pks.append(pk)
+                new_pvs.append(pv)
+            idx = jnp.clip(chunk_len - 1, 0, Sc - 1)
+            first_logits = logits_of(x[jnp.arange(B), idx])
+            tok0 = sample_rows(
+                first_logits,
+                jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys0),
+                temps)
+            return new_pks, new_pvs, tok0
+
+        return jax.jit(run, donate_argnums=(0, 1))
+
+    def _build_paged_step(self, T, block_size):
+        """Jitted one-token decode step over the block pool: each
+        row feeds its previous token at position ``pos`` (k/v
+        scattered to table block ``pos // bs``, slot ``pos % bs``),
+        attends positions 0..pos through the gathered table, and
+        samples the next token with PRNG fold index ``gen_idx`` —
+        the same per-row stream as ``generate_bucketed``.  Rows of
+        DIFFERENT requests, lengths, and ages share one call; pad
+        rows carry all-trash tables and scatter junk into block 0."""
+        import jax
+        import jax.numpy as jnp
+        emb_w, emb_pos, head_w, head_b, block_params, n_heads = \
+            self._paged_lm_tables()
+        P = emb_pos.shape[0]
+        V = emb_w.shape[0]
+        bs = int(block_size)
+        S_keys = T * bs
+
+        def logits_of(x_last):
+            return _head_logits(x_last, head_w, head_b)
+
+        sample_rows = _sample_rows
+
+        def run(pks, pvs, tables, pos, tok, gen_idx, temps, seeds):
+            keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
+            posn = jnp.clip(pos, 0, P - 1)
+            x = emb_w[jnp.clip(tok, 0, V - 1)][:, None] + \
+                jnp.take(emb_pos, posn, axis=0)[:, None]
+            wpos = jnp.clip(pos, 0, S_keys - 1)
+            wblock = jnp.take_along_axis(
+                tables, (wpos // bs)[:, None], axis=1)
+            wslot = (wpos % bs)[:, None]
+            key_mask = (jnp.arange(S_keys)[None, None, :] <=
+                        pos[:, None, None])
+            new_pks, new_pvs = [], []
+            for pk, pv, p, H in zip(pks, pvs, block_params, n_heads):
+                x, pk, pv = self._paged_block(
+                    p, x, pk, pv, tables, wblock, wslot, key_mask, H)
+                new_pks.append(pk)
+                new_pvs.append(pv)
+            logits = logits_of(x[:, 0])
+            tok_new = sample_rows(
+                logits, jax.vmap(jax.random.fold_in)(keys0, gen_idx),
+                temps)
+            return new_pks, new_pvs, tok_new
+
+        return jax.jit(run, donate_argnums=(0, 1))
+
+    def paged_extend(self, pool, tables, tokens, prior, chunk_lens,
+                     temps, seeds):
+        """Prefill/extend entry point for the serving engine:
+        ``tables`` (B, T) int32 block tables (trash-padded),
+        ``tokens`` (B, Sc) right-padded chunk tokens, ``prior`` (B,)
+        cached positions per row, ``chunk_lens`` (B,) real chunk
+        lengths.  Updates ``pool.storage`` in place (donated on
+        accelerators) and returns the (B,) first generated tokens.
+        Compiles once per (B, Sc, T, n_blocks, block_size) — POOL
+        GEOMETRY IS PART OF THE KEY: resizing the pool or its blocks
+        must never serve a stale program."""
+        tables = numpy.ascontiguousarray(tables, dtype=numpy.int32)
+        tokens = numpy.ascontiguousarray(tokens, dtype=numpy.int32)
+        B, T = tables.shape
+        Sc = tokens.shape[1]
+        fn = self.compile_cache.get_or_build(
+            ("pext", B, Sc, T, pool.n_blocks, pool.block_size),
+            lambda: self._build_paged_extend(Sc, T, pool.block_size))
+        ks, vs = pool.storage
+        ks, vs, tok0 = fn(
+            ks, vs, tables, tokens,
+            numpy.ascontiguousarray(prior, dtype=numpy.int32),
+            numpy.ascontiguousarray(chunk_lens, dtype=numpy.int32),
+            numpy.ascontiguousarray(temps, dtype=numpy.float32),
+            numpy.ascontiguousarray(seeds, dtype=numpy.uint32))
+        pool.storage = (ks, vs)
+        return numpy.asarray(tok0)
+
+    def paged_step(self, pool, tables, pos, tok, gen_idx, temps,
+                   seeds):
+        """One decode step for the engine's continuous batch: every
+        active row advances one token through the pool.  Compiles
+        once per (B, T, n_blocks, block_size)."""
+        tables = numpy.ascontiguousarray(tables, dtype=numpy.int32)
+        B, T = tables.shape
+        fn = self.compile_cache.get_or_build(
+            ("pstep", B, T, pool.n_blocks, pool.block_size),
+            lambda: self._build_paged_step(T, pool.block_size))
+        ks, vs = pool.storage
+        ks, vs, tok_new = fn(
+            ks, vs, tables,
+            numpy.ascontiguousarray(pos, dtype=numpy.int32),
+            numpy.ascontiguousarray(tok, dtype=numpy.int32),
+            numpy.ascontiguousarray(gen_idx, dtype=numpy.int32),
+            numpy.ascontiguousarray(temps, dtype=numpy.float32),
+            numpy.ascontiguousarray(seeds, dtype=numpy.uint32))
+        pool.storage = (ks, vs)
+        return numpy.asarray(tok_new)
 
     @staticmethod
     def _jax_pool(t, cfg, x):

@@ -432,6 +432,17 @@ class Launcher(Logger):
                   if not k.startswith("net.")}
         if events:
             payload["resilience"] = events
+        # Serving row: any live ServingEngine in this process (an
+        # in-workflow RESTfulAPI unit) ships its decode tok/s, queue
+        # depth, and KV-pool occupancy so the soak's numbers are
+        # live operator metrics, not just bench output.
+        try:
+            from .serving.metrics import live_serving_summary
+            serving = live_serving_summary()
+        except Exception:
+            serving = None
+        if serving:
+            payload["serving"] = serving
         # Dashboard depth (reference: web_status.py:113-243 shows the
         # Graphviz workflow graph and plot links): the DOT text rides
         # the first beat and a ~per-minute refresh (the dashboard

@@ -73,6 +73,18 @@ def init_parser(parser):
         "--serve-warmup", action="store_true",
         help="serving: precompile the shape-bucket grid at startup "
              "so the first request never pays an XLA compile")
+    parser.add_argument(
+        "--serve-kv-blocks", type=int, default=None, metavar="N",
+        help="serving: paged KV cache pool size in blocks (default: "
+             "sized so max-batch rows can each hold a full-length "
+             "sequence)")
+    parser.add_argument(
+        "--serve-kv-block-size", type=int, default=None, metavar="N",
+        help="serving: tokens per paged KV cache block (default 16)")
+    parser.add_argument(
+        "--serve-no-paged", action="store_true",
+        help="serving: disable paged decode-step batching and fall "
+             "back to whole-request generate batching")
 
 
 def serving_config_defaults():
@@ -80,7 +92,8 @@ def serving_config_defaults():
     ``--serve-*`` flags); explicit unit kwargs win."""
     out = {}
     for key in ("max_batch", "queue_depth", "rate_limit", "deadline",
-                "token", "warmup"):
+                "token", "warmup", "kv_blocks", "kv_block_size",
+                "paged"):
         value = root.common.serving.get(key)
         if value is not None:
             out[key] = value
@@ -116,7 +129,8 @@ class ModelServer(JsonHttpServer):
 
     def __init__(self, model, host="0.0.0.0", port=8180, token=None,
                  max_batch=8, queue_depth=64, rate_limit=None,
-                 deadline=30.0, warmup=False, policy=None):
+                 deadline=30.0, warmup=False, policy=None,
+                 paged=None, kv_blocks=None, kv_block_size=16):
         if isinstance(model, str):
             model = ExportedModel(model)
         self.model = model
@@ -125,7 +139,8 @@ class ModelServer(JsonHttpServer):
         self.warmup = warmup
         self.engine = ServingEngine(
             model, max_batch=max_batch, queue_depth=queue_depth,
-            policy=policy, default_deadline=deadline)
+            policy=policy, default_deadline=deadline, paged=paged,
+            kv_blocks=kv_blocks, kv_block_size=kv_block_size)
         self.limiter = RateLimiter(rate_limit) if rate_limit else None
 
         class Handler(JsonRequestHandler):
@@ -296,6 +311,9 @@ class ModelServer(JsonHttpServer):
         cache = getattr(self.model, "compile_cache", None)
         if cache is not None:
             payload["compile_cache"] = cache.stats()
+        pool = self.engine.kv_pool
+        if pool is not None:
+            payload["kv_pool"] = pool.occupancy()
         if self.limiter is not None:
             payload["rate_limit"] = {"rate": self.limiter.rate,
                                      "clients": len(self.limiter)}
@@ -331,10 +349,12 @@ class RESTfulAPI(Unit):
     it after the Decision; when the workflow finishes training it
     exports the forward chain and serves until stopped — through the
     serving engine (shape-bucketed dynamic batching, admission
-    control), configured by the ``--serve-max-batch`` /
+    control, paged decode-step batching over LM artifacts),
+    configured by the ``--serve-max-batch`` /
     ``--serve-queue-depth`` / ``--serve-rate-limit`` /
-    ``--serve-deadline`` / ``--serve-token`` / ``--serve-warmup``
-    CLI flags or the matching kwargs below."""
+    ``--serve-deadline`` / ``--serve-token`` / ``--serve-warmup`` /
+    ``--serve-kv-blocks`` / ``--serve-kv-block-size`` /
+    ``--serve-no-paged`` CLI flags or the matching kwargs below."""
 
     def __init__(self, workflow, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
@@ -351,6 +371,9 @@ class RESTfulAPI(Unit):
         self.deadline = kwargs.get("deadline", 30.0)
         self.token = kwargs.get("token", None)
         self.warmup = kwargs.get("warmup", False)
+        self.paged = kwargs.get("paged", None)
+        self.kv_blocks = kwargs.get("kv_blocks", None)
+        self.kv_block_size = kwargs.get("kv_block_size", 16)
         self.server = None
 
     def run(self):
@@ -361,7 +384,9 @@ class RESTfulAPI(Unit):
             self.artifact_path, host=self.host, port=self.port,
             token=self.token, max_batch=self.max_batch,
             queue_depth=self.queue_depth, rate_limit=self.rate_limit,
-            deadline=self.deadline, warmup=self.warmup)
+            deadline=self.deadline, warmup=self.warmup,
+            paged=self.paged, kv_blocks=self.kv_blocks,
+            kv_block_size=self.kv_block_size)
         self.port = self.server.port
         if self.blocking:
             self.server.serve()

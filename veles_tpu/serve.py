@@ -2,6 +2,8 @@
 exported artifact over HTTP (reference analogue: running a workflow
 under velescli with the RESTfulAPI unit, restful_api.py:78), through
 the production serving engine: shape-bucketed dynamic batching,
+paged KV-cache decode-step continuous batching for LM artifacts
+(``--kv-blocks`` / ``--kv-block-size`` / ``--no-paged-decode``),
 ``--warmup`` grid precompilation, per-client rate limiting, and
 queue-depth backpressure (docs/serving.md)."""
 
@@ -43,12 +45,25 @@ def main(argv=None):
         "--warmup", action="store_true",
         help="precompile the shape-bucket grid before serving so "
              "the first request never pays an XLA compile")
+    parser.add_argument(
+        "--kv-blocks", type=int, default=None, metavar="N",
+        help="paged KV cache pool size in blocks (default: sized so "
+             "max-batch rows can each hold a full-length sequence)")
+    parser.add_argument(
+        "--kv-block-size", type=int, default=16, metavar="N",
+        help="tokens per paged KV cache block (default 16)")
+    parser.add_argument(
+        "--no-paged-decode", action="store_true",
+        help="disable paged decode-step continuous batching and "
+             "fall back to whole-request generate batching")
     args = parser.parse_args(argv)
     server = ModelServer(
         args.artifact, host=args.host, port=args.port,
         token=args.token, max_batch=args.max_batch,
         queue_depth=args.queue_depth, rate_limit=args.rate_limit,
-        deadline=args.deadline, warmup=args.warmup)
+        deadline=args.deadline, warmup=args.warmup,
+        paged=False if args.no_paged_decode else None,
+        kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size)
     try:
         server.serve()
     except KeyboardInterrupt:

@@ -19,15 +19,20 @@ between the HTTP handlers (:mod:`veles_tpu.restful`) and the device:
   :class:`~veles_tpu.serving.engine.ServingEngine`: a bounded request
   queue and a dedicated device thread that coalesces compatible
   requests into padded batches (per-request masking, so stragglers
-  never corrupt a neighbor's result).
+  never corrupt a neighbor's result) — and, over LM artifacts, runs
+  generate traffic through DECODE-STEP continuous batching on a
+  paged KV block pool (:class:`veles_tpu.export.KVBlockPool`):
+  requests join the running batch at any token boundary, retire the
+  moment their budget is met, and common prompt prefixes are
+  prefilled once and refcount-shared.
 
-Every future inference PR (multi-host serving, KV-cache paging,
-speculative decoding) builds on this layer; see docs/serving.md.
+Future inference PRs (multi-host serving, speculative decoding)
+build on this layer; see docs/serving.md.
 """
 
 from .admission import (AdmissionError, DeadlineExceeded,  # noqa: F401
-                        EngineStopped, QueueFull, RateLimited,
-                        RateLimiter, TokenBucket)
+                        EngineStopped, PoolExhausted, QueueFull,
+                        RateLimited, RateLimiter, TokenBucket)
 from .buckets import BucketPolicy, CompileCache, next_pow2  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .metrics import ServingStats  # noqa: F401

@@ -38,6 +38,16 @@ class QueueFull(AdmissionError):
     """The engine's bounded request queue is at depth."""
 
 
+class PoolExhausted(AdmissionError):
+    """The paged KV block pool cannot cover the request's worst-case
+    block need on top of what is already committed to queued and
+    active requests — the decode-capacity analogue of
+    :class:`QueueFull` (429 + ``Retry-After`` estimated from the
+    running batch's retirement horizon).  Under paged decode this is
+    the PRIMARY shed point: queue depth bounds memory for request
+    payloads, but the block pool is what actually runs out."""
+
+
 class DeadlineExceeded(AdmissionError):
     """The request's deadline expired before (or while) the device
     could serve it — the work is cancelled, not attempted."""

@@ -1,22 +1,36 @@
 """The serving engine: HTTP I/O decoupled from device execution.
 
 One dedicated device thread owns the model; HTTP handler threads only
-enqueue.  The device thread drains the bounded queue in arrival
-order, coalescing every compatible waiting request into one padded
-batch (Orca-style continuous batching, adapted to whole-request
-granularity): classify requests sharing a sample width ride one
-``forward``, generate requests sharing a (prompt-bucket, decode-
-bucket) pair ride one ``generate_bucketed`` call with per-request
-length masking — a straggler padded up to the bucket can never
-corrupt a neighbor's result, because masked positions are excluded
-from attention and each row's output is sliced to its own true
-geometry.
+enqueue.  Two scheduling regimes share the thread:
+
+* **Classify / dense generate** — the device thread drains the
+  bounded queue in arrival order, coalescing every compatible waiting
+  request into one padded batch: classify requests sharing a sample
+  width ride one ``forward``, dense generate requests sharing a
+  (prompt-bucket, decode-bucket) pair ride one ``generate_bucketed``
+  call with per-request length masking.
+
+* **Paged decode** (models exposing the block-pool surface —
+  :class:`veles_tpu.export.ExportedModel` LM artifacts) — Orca-style
+  iteration-level scheduling over a vLLM-style
+  :class:`~veles_tpu.export.KVBlockPool`: a request is prefilled once
+  (riding the bucketed-chunk ``paged_extend`` program, adopting any
+  cached prompt prefix), then its block table joins the PERSISTENT
+  decode batch, which advances every active row one token per
+  ``paged_step`` call.  Rows join at any token boundary, retire the
+  moment their budget is met (freeing their blocks immediately), and
+  a straggler no longer holds a whole batch hostage.  Shapes stay
+  static for the bucketed-jit world: batch and table widths round to
+  power-of-two buckets, pad rows carry all-trash tables.
 
 Admission is enforced at the door (:mod:`.admission`): a full queue
-raises :class:`~veles_tpu.serving.admission.QueueFull` (the HTTP
-layer turns it into 429 + ``Retry-After``), and a request whose
-deadline expires while queued is cancelled without ever touching the
-device — work the client has abandoned is not worth a TPU millisecond.
+raises :class:`~veles_tpu.serving.admission.QueueFull`; under paged
+decode the binding limit is the BLOCK POOL — a request whose
+worst-case block need does not fit on top of what is already
+committed raises :class:`~veles_tpu.serving.admission.PoolExhausted`
+(both become 429 + ``Retry-After`` at the HTTP layer).  A request
+whose deadline expires while queued — or mid-decode — is cancelled
+without spending another device millisecond on it.
 """
 
 import collections
@@ -28,9 +42,10 @@ import numpy
 from ..error import Bug
 from ..logger import Logger
 from ..resilience import Deadline
-from .admission import DeadlineExceeded, EngineStopped, QueueFull
-from .buckets import BucketPolicy
-from .metrics import ServingStats
+from .admission import (DeadlineExceeded, EngineStopped,
+                        PoolExhausted, QueueFull)
+from .buckets import BucketPolicy, next_pow2
+from .metrics import ServingStats, register_engine, unregister_engine
 
 
 class _Request(object):
@@ -39,7 +54,8 @@ class _Request(object):
 
     __slots__ = ("kind", "key", "rows", "x", "tokens", "length",
                  "max_new", "temperature", "seed", "deadline",
-                 "result", "error", "event", "t_submit")
+                 "result", "error", "event", "t_submit",
+                 "kv_commit", "row_results", "rows_done")
 
     def __init__(self, kind, key, rows, deadline):
         self.kind = kind
@@ -56,17 +72,44 @@ class _Request(object):
         self.error = None
         self.event = threading.Event()
         self.t_submit = time.monotonic()
+        self.kv_commit = 0         # blocks reserved at admission
+        self.row_results = None    # per-row generated-token lists
+        self.rows_done = 0
+
+
+class _PagedRow(object):
+    """One active row of the persistent decode batch: its block
+    table, its write position, and the token it feeds next."""
+
+    __slots__ = ("req", "row_idx", "table", "n_blocks", "pos", "tok",
+                 "gen", "prior", "chunk", "prefix_chain")
+
+    def __init__(self, req, row_idx, table, n_blocks):
+        self.req = req
+        self.row_idx = row_idx
+        self.table = table          # physical block ids, in order
+        self.n_blocks = n_blocks    # real entries in the table
+        self.pos = 0                # next cache write position
+        self.tok = 0                # last token (fed next step)
+        self.gen = None             # generated tokens so far
+        self.prior = 0              # cached positions at prefill
+        self.chunk = None           # prompt remainder to prefill
+        self.prefix_chain = None    # prompt block digests (reused)
 
 
 class ServingEngine(Logger):
     """Bounded queue + device thread + dynamic batching over a model
     exposing ``forward(x)`` (and, for LM artifacts,
-    ``generate_bucketed(prompts, lengths, max_new, temperatures,
-    seeds)`` — :class:`veles_tpu.export.ExportedModel` provides both;
-    any duck-typed model with the same surface serves too)."""
+    ``generate_bucketed(...)`` — :class:`veles_tpu.export
+    .ExportedModel` provides both; any duck-typed model with the same
+    surface serves too).  When the model also exposes the paged
+    surface (``make_kv_pool`` / ``paged_extend`` / ``paged_step``),
+    generate traffic runs through decode-step continuous batching by
+    default (``paged=False`` opts out)."""
 
     def __init__(self, model, max_batch=8, queue_depth=64,
-                 policy=None, stats=None, default_deadline=30.0):
+                 policy=None, stats=None, default_deadline=30.0,
+                 paged=None, kv_blocks=None, kv_block_size=16):
         super(ServingEngine, self).__init__()
         self.model = model
         self.max_batch = int(max_batch)
@@ -79,22 +122,60 @@ class ServingEngine(Logger):
             prompt_cap=self._max_position)
         self.stats = stats or ServingStats()
         self.default_deadline = default_deadline
-        self._pending = collections.deque()
+        self.kv_block_size = int(kv_block_size)
+        self.kv_blocks = kv_blocks
+        self.kv_pool = None
+        supported = bool(
+            self._max_position and
+            hasattr(model, "make_kv_pool") and
+            hasattr(model, "paged_extend") and
+            hasattr(model, "paged_step"))
+        if paged is None:
+            self.paged = supported
+        else:
+            self.paged = bool(paged)
+            if self.paged and not supported:
+                raise Bug("paged decode requested but the model has "
+                          "no paged surface (make_kv_pool / "
+                          "paged_extend / paged_step + max_position)")
+        self._pending = collections.deque()     # classify + dense gen
+        self._paged_wait = collections.deque()  # awaiting adoption
+        self._rows = []                         # active decode rows
+        self._kv_committed = 0                  # blocks reserved
         self._cond = threading.Condition()
         self._thread = None
         self._stopped = False
-        self._batch_seconds_ewma = None  # recent device-batch cost
+        self._batch_ewma = {}  # kind -> recent device-batch cost
 
     # -- lifecycle ---------------------------------------------------------
+
+    def _default_kv_blocks(self):
+        """Pool sizing when the operator doesn't say: every one of
+        ``max_batch`` concurrent rows can hold a full-length
+        sequence, plus the trash block and headroom for resident
+        prefix-cache entries."""
+        per_row = -(-int(self._max_position) // self.kv_block_size)
+        return self.max_batch * per_row + 1 + 16
+
+    def _ensure_pool(self):
+        if self.paged and self.kv_pool is None:
+            n = self.kv_blocks or self._default_kv_blocks()
+            self.kv_pool = self.model.make_kv_pool(
+                n, self.kv_block_size)
+            self.info("paged KV pool: %d blocks x %d slots "
+                      "(block 0 = trash)", n, self.kv_block_size)
+        return self.kv_pool
 
     def start(self):
         if self._thread is not None:
             return self
+        self._ensure_pool()
         self._stopped = False
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name="veles-serving-device")
         self._thread.start()
+        register_engine(self)
         return self
 
     def stop(self):
@@ -104,29 +185,62 @@ class ServingEngine(Logger):
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
-        # Anything still queued is cancelled, not silently dropped —
-        # a blocked submitter must wake with an error (503: the
-        # server's state, retryable, never a client fault).
+        unregister_engine(self)
+        # Anything still queued or mid-decode is cancelled, not
+        # silently dropped — a blocked submitter must wake with an
+        # error (503: the server's state, retryable, never a client
+        # fault).
+        for req in {row.req for row in self._rows}:
+            self._fail_req(req, EngineStopped("serving engine "
+                                              "stopped"))
         while self._pending:
             req = self._pending.popleft()
+            req.error = EngineStopped("serving engine stopped")
+            req.event.set()
+        while self._paged_wait:
+            req = self._paged_wait.popleft()
+            with self._cond:
+                self._kv_committed -= req.kv_commit
             req.error = EngineStopped("serving engine stopped")
             req.event.set()
 
     def queue_depth_now(self):
         with self._cond:
-            return len(self._pending)
+            return len(self._pending) + len(self._paged_wait)
 
     def _drain_estimate_locked(self):
         """Retry-After for a rejected request: how long the current
-        queue should take to drain, from the recent device-batch cost
-        (each drained batch retires up to ``max_batch`` queued
-        requests).  Floors at 1 s; before any batch has run (no
-        signal yet) that floor is all we claim."""
-        ewma = self._batch_seconds_ewma
-        if ewma is None:
+        queue should take to drain, from the recent device-batch
+        cost PER REQUEST KIND and the queue's actual kind mix — a
+        multi-second generate batch must not poison the estimate a
+        cheap classify flood is quoted (each drained batch retires
+        up to ``max_batch`` queued requests of its kind).  Floors at
+        1 s; a kind with no signal yet claims that floor."""
+        counts = {}
+        for req in self._pending:
+            counts[req.kind] = counts.get(req.kind, 0) + 1
+        if self._paged_wait:
+            counts["generate"] = counts.get("generate", 0) + \
+                len(self._paged_wait)
+        total = 0.0
+        for kind, n in counts.items():
+            ewma = self._batch_ewma.get(kind)
+            if ewma is None:
+                total += 1.0  # no signal for this kind: the floor
+                continue
+            total += -(-n // max(1, self.max_batch)) * ewma
+        return min(60.0, max(1.0, total))
+
+    def _pool_retry_locked(self):
+        """Retry-After for a pool-exhausted rejection: blocks free up
+        when the CLOSEST active row retires, so quote its remaining
+        decode steps at the recent per-step cost."""
+        if not self._rows:
             return 1.0
-        batches = -(-len(self._pending) // max(1, self.max_batch))
-        return min(60.0, max(1.0, batches * ewma))
+        remaining = min(row.req.max_new - len(row.gen or ())
+                        for row in self._rows)
+        step = self._batch_ewma.get("decode", 0.05)
+        return min(60.0, max(1.0, remaining * step))
 
     # -- submission (HTTP handler threads) ---------------------------------
 
@@ -141,6 +255,12 @@ class ServingEngine(Logger):
                     retry_after=self._drain_estimate_locked())
             self._pending.append(req)
             self._cond.notify()
+        return self._finish_wait(req)
+
+    def _finish_wait(self, req):
+        """Blocks the submitter on the request's completion event,
+        surfacing device-thread stalls as 504 and re-raising any
+        error the device thread attached."""
         budget = req.deadline.remaining() if req.deadline is not None \
             else None
         finished = req.event.wait(
@@ -169,6 +289,7 @@ class ServingEngine(Logger):
         if x.ndim == 1:
             x = x[None]
         deadline = self._deadline(deadline)
+        self._check_deadline_eager(deadline)
         if x.shape[0] > self.max_batch:
             return numpy.concatenate([
                 self.submit_classify(x[at:at + self.max_batch],
@@ -183,7 +304,9 @@ class ServingEngine(Logger):
     def submit_generate(self, tokens, max_new, temperature=0.0,
                         seed=0, deadline=None):
         """Blocking: autoregressive decode for one request (possibly
-        multi-row); returns the (B, prompt+max_new) full sequences."""
+        multi-row); returns the (B, prompt+max_new) full sequences.
+        Under paged decode the request's rows join the persistent
+        step batch after prefill and retire independently."""
         tokens = numpy.atleast_2d(
             numpy.asarray(tokens, dtype=numpy.int32))
         max_new = int(max_new)
@@ -205,8 +328,13 @@ class ServingEngine(Logger):
         # where an int64 overflow would 500 every request coalesced
         # into the same batch.
         seed = int(seed) & 0xFFFFFFFF
+        # The ORIGINAL deadline is resolved once and threaded through
+        # every chunk of an oversized request — the caller's budget
+        # is end-to-end, not per chunk — and an (almost-)expired
+        # budget fails fast instead of half-generating.
+        deadline = self._deadline(deadline)
+        self._check_deadline_eager(deadline)
         if tokens.shape[0] > self.max_batch:
-            deadline = self._deadline(deadline)
             return numpy.concatenate([
                 self.submit_generate(
                     tokens[at:at + self.max_batch], max_new,
@@ -223,6 +351,9 @@ class ServingEngine(Logger):
                 "prompt %d + %d new tokens exceeds the model's "
                 "positional table (%d)" %
                 (tokens.shape[1], max_new, limit))
+        if self.paged:
+            return self._submit_paged(tokens, max_new, temperature,
+                                      seed, deadline)
         s_bucket = self.policy.prompt_bucket(tokens.shape[1])
         m_bucket = self.policy.new_bucket(max_new)
         if limit is not None:
@@ -232,13 +363,67 @@ class ServingEngine(Logger):
             # true length).
             s_bucket = min(s_bucket, limit)
         req = _Request("generate", ("g", s_bucket, m_bucket),
-                       tokens.shape[0], self._deadline(deadline))
+                       tokens.shape[0], deadline)
         req.tokens = tokens
         req.length = tokens.shape[1]
         req.max_new = int(max_new)
         req.temperature = float(temperature)
         req.seed = int(seed)
         return self._enqueue(req)
+
+    def _submit_paged(self, tokens, max_new, temperature, seed,
+                      deadline):
+        """Paged admission: the binding resource is the BLOCK POOL,
+        not the queue — a request reserves its worst-case block need
+        at the door and is shed with 429 :class:`PoolExhausted` when
+        the reservation does not fit on top of what queued and
+        active requests already hold.  (Prefix sharing can only make
+        the realized need smaller, so reservations never over-admit.)
+        """
+        req = _Request("generate", ("pg",), tokens.shape[0], deadline)
+        req.tokens = tokens
+        req.length = tokens.shape[1]
+        req.max_new = int(max_new)
+        req.temperature = float(temperature)
+        req.seed = int(seed)
+        per_row = -(-(req.length + req.max_new) // self.kv_block_size)
+        req.kv_commit = per_row * req.rows
+        req.row_results = [None] * req.rows
+        with self._cond:
+            if self._stopped:
+                raise EngineStopped("serving engine is not running")
+            pool = self._ensure_pool()
+            if req.kv_commit > pool.usable:
+                raise Bug(
+                    "request needs %d KV blocks but the pool holds "
+                    "%d — raise --kv-blocks or shrink the request" %
+                    (req.kv_commit, pool.usable))
+            if len(self._paged_wait) >= self.queue_depth:
+                # The pool is the PRIMARY shed point, but the queue
+                # bound stays live as the payload-memory backstop —
+                # tiny requests could otherwise park thousands of
+                # handler threads on a big pool.
+                self.stats.incr("rejected.queue_full")
+                raise QueueFull(
+                    "request queue at depth %d" % self.queue_depth,
+                    retry_after=self._drain_estimate_locked())
+            if self._kv_committed + req.kv_commit > pool.usable:
+                self.stats.incr("rejected.pool_exhausted")
+                raise PoolExhausted(
+                    "KV pool exhausted: %d blocks committed, %d "
+                    "more needed, %d usable" %
+                    (self._kv_committed, req.kv_commit, pool.usable),
+                    retry_after=self._pool_retry_locked())
+            self._kv_committed += req.kv_commit
+            self._paged_wait.append(req)
+            self._cond.notify()
+        return self._finish_wait(req)
+
+    def _check_deadline_eager(self, deadline):
+        if deadline is not None and deadline.expired:
+            self.stats.incr("cancelled.deadline")
+            raise DeadlineExceeded(
+                "deadline expired before submission")
 
     def _deadline(self, deadline):
         if deadline is not None:
@@ -252,13 +437,20 @@ class ServingEngine(Logger):
     def _loop(self):
         while True:
             with self._cond:
-                while not self._pending and not self._stopped:
+                while not (self._pending or self._paged_wait or
+                           self._rows or self._stopped):
                     self._cond.wait(0.5)
                 if self._stopped:
                     return
-                batch = self._take_batch_locked()
+                batch = self._take_batch_locked() if self._pending \
+                    else None
+                adopt = self._take_paged_locked()
+            if adopt:
+                self._paged_prefill(adopt)
             if batch:
                 self._execute(batch)
+            if self._rows:
+                self._paged_step_once()
 
     def _take_batch_locked(self):
         """Head-of-queue plus every compatible waiting request, up to
@@ -275,6 +467,27 @@ class ServingEngine(Logger):
                 batch.append(req)
                 rows += req.rows
         return batch
+
+    def _take_paged_locked(self):
+        """Paged requests adopted at this token boundary: FIFO, as
+        many as fit beside the active rows (the step batch is capped
+        at ``max_batch`` device rows).  Requests whose deadline
+        expired while waiting are cancelled here, unserved."""
+        out = []
+        rows = len(self._rows)
+        while self._paged_wait:
+            req = self._paged_wait[0]
+            if req.deadline is not None and req.deadline.expired:
+                self._paged_wait.popleft()
+                self._kv_committed -= req.kv_commit
+                self._cancel(req)
+                continue
+            if rows + req.rows > self.max_batch:
+                break
+            self._paged_wait.popleft()
+            out.append(req)
+            rows += req.rows
+        return out
 
     def _cancel(self, req):
         self.stats.incr("cancelled.deadline")
@@ -301,9 +514,7 @@ class ServingEngine(Logger):
             dt = time.monotonic() - t0
             self.stats.observe_batch(
                 live[0].kind, sum(r.rows for r in live), dt)
-            ewma = self._batch_seconds_ewma
-            self._batch_seconds_ewma = dt if ewma is None \
-                else 0.8 * ewma + 0.2 * dt
+            self._note_ewma(live[0].kind, dt)
         except Exception as e:
             for req in live:
                 if req.error is None:
@@ -311,6 +522,11 @@ class ServingEngine(Logger):
         finally:
             for req in live:
                 req.event.set()
+
+    def _note_ewma(self, kind, dt):
+        ewma = self._batch_ewma.get(kind)
+        self._batch_ewma[kind] = dt if ewma is None \
+            else 0.8 * ewma + 0.2 * dt
 
     def _run_classify(self, live):
         x = numpy.concatenate([r.x for r in live], axis=0)
@@ -368,6 +584,308 @@ class ServingEngine(Logger):
             req.result = numpy.concatenate([req.tokens, new], axis=1)
             at += req.rows
 
+    # -- paged decode: prefill + persistent step batch ---------------------
+
+    def _paged_prefill(self, reqs):
+        """Adopt freshly taken requests into the decode batch: per
+        row, match the longest cached prompt prefix (adopting its
+        blocks, COW-copying the last one when the first write would
+        land inside it), allocate the remainder of the table, and
+        run ONE coalesced ``paged_extend`` over every adopted row —
+        different prefix depths ride together because each row
+        carries its own ``prior``/``chunk_len``."""
+        pool = self.kv_pool
+        rows = []
+        for req in reqs:
+            req_rows, failed = [], None
+            for i in range(req.rows):
+                try:
+                    row = self._build_paged_row(req, i)
+                except Exception as e:
+                    # A device fault inside COW (jit compile, OOM)
+                    # must fail THIS request, never escape and kill
+                    # the device thread — the dense path's _execute
+                    # invariant, kept here.
+                    self.exception("paged row adoption failed")
+                    failed = e
+                    break
+                if row is None:
+                    # Defensive: admission's worst-case reservation
+                    # should make this unreachable; if it happens,
+                    # shed with the same 429 + accounting the
+                    # door-time path uses.
+                    self.stats.incr("rejected.pool_exhausted")
+                    with self._cond:
+                        retry = self._pool_retry_locked()
+                    failed = PoolExhausted(
+                        "KV pool exhausted during adoption",
+                        retry_after=retry)
+                    break
+                req_rows.append(row)
+            if failed is not None:
+                for row in req_rows:
+                    self._release_row_blocks(row)
+                with self._cond:
+                    self._kv_committed -= req.kv_commit
+                req.error = failed
+                req.event.set()
+                continue
+            rows.extend(req_rows)
+        if not rows:
+            return
+        try:
+            self._run_paged_extend(rows)
+        except Exception as e:
+            self.exception("paged prefill failed")
+            self._paged_wreck(rows, e)
+            return
+        now = time.monotonic()
+        live = []
+        for row in rows:
+            req = row.req
+            self.stats.observe_latency("ttft.generate",
+                                       now - req.t_submit)
+            try:
+                pool.register_prefix(req.tokens[row.row_idx],
+                                     row.table,
+                                     chain=row.prefix_chain)
+            except Exception:
+                # Losing a cache registration costs a future prefix
+                # hit, never the request.
+                self.exception("prefix registration failed")
+            if req.max_new <= len(row.gen):
+                self._retire_row(row)
+            else:
+                live.append(row)
+        if live:
+            with self._cond:
+                self._rows.extend(live)
+        self.stats.note_tokens(len(rows))
+        self.stats.incr("tokens.generated", len(rows))
+        self._update_gauges()
+
+    def _build_paged_row(self, req, i):
+        """Block table + prefill plan for one request row, or None
+        when the pool cannot supply it (structurally rare: the
+        admission reservation covers the worst case, and ``alloc``
+        evicts cached prefixes under pressure)."""
+        pool = self.kv_pool
+        tokens_row = req.tokens[i]
+        length = req.length
+        total_blocks = pool.blocks_for(length + req.max_new)
+        chain = pool.prefix_chain(tokens_row[:length])
+        k_full, shared = pool.lookup_prefix(tokens_row[:length],
+                                            chain=chain)
+        if shared and k_full * pool.block_size == length:
+            # The WHOLE prompt is cached: re-feed only its last
+            # token to recover the first logits.  That write lands
+            # at position len-1 — inside the last shared block — so
+            # copy-on-write gives this row a private copy first.
+            fresh_block = pool.cow_copy(shared[-1])
+            if fresh_block is None:
+                pool.release(shared)
+                return None
+            pool.release([shared[-1]])
+            shared[-1] = fresh_block
+            prior = length - 1
+        else:
+            prior = k_full * pool.block_size
+        fresh_needed = total_blocks - len(shared)
+        fresh = pool.alloc(fresh_needed) if fresh_needed > 0 else []
+        if fresh is None:
+            pool.release(shared)
+            return None
+        row = _PagedRow(req, i, shared + fresh, total_blocks)
+        row.prior = prior
+        row.chunk = tokens_row[prior:length]
+        row.prefix_chain = chain
+        return row
+
+    def _run_paged_extend(self, rows):
+        """One coalesced chunk-prefill call for every adopted row."""
+        pool = self.kv_pool
+        n = len(rows)
+        B = self.policy.batch_bucket(n)
+        Sc = self.policy.prompt_bucket(max(len(r.chunk)
+                                           for r in rows))
+        limit = self._max_position
+        if limit is not None:
+            Sc = min(Sc, limit)
+        T = next_pow2(max(r.n_blocks for r in rows))
+        tables = numpy.zeros((B, T), numpy.int32)
+        tokens = numpy.zeros((B, Sc), numpy.int32)
+        prior = numpy.zeros(B, numpy.int32)
+        clens = numpy.ones(B, numpy.int32)
+        temps = numpy.zeros(B, numpy.float32)
+        seeds = numpy.zeros(B, numpy.uint32)
+        for at, row in enumerate(rows):
+            req = row.req
+            tables[at, :row.n_blocks] = row.table
+            tokens[at, :len(row.chunk)] = row.chunk
+            prior[at] = row.prior
+            clens[at] = len(row.chunk)
+            temps[at] = req.temperature
+            seeds[at] = (req.seed + row.row_idx) & 0xFFFFFFFF
+        t0 = time.monotonic()
+        tok0 = self.model.paged_extend(pool, tables, tokens, prior,
+                                       clens, temps, seeds)
+        dt = time.monotonic() - t0
+        self.stats.observe_batch("prefill", n, dt)
+        # Prefill cost is what a queued generate request waits on —
+        # it feeds the "generate" drain estimate.
+        self._note_ewma("generate", dt)
+        for at, row in enumerate(rows):
+            row.tok = int(tok0[at])
+            row.gen = [row.tok]
+            row.pos = row.prior + len(row.chunk)
+
+    def _paged_step_once(self):
+        """Advance every active decode row one token — the heart of
+        iteration-level scheduling: rows of different requests, ages,
+        and lengths share the call; finished rows retire immediately
+        and new requests are adopted at the next boundary."""
+        progress = {}
+        for row in self._rows:
+            req = row.req
+            if req.deadline is not None and req.deadline.expired:
+                progress[req] = max(progress.get(req, 0),
+                                    len(row.gen or ()))
+        for req, done in progress.items():
+            self.stats.incr("cancelled.deadline")
+            self._fail_req(req, DeadlineExceeded(
+                "deadline expired after %d of %d tokens" %
+                (done, req.max_new)))
+        rows = list(self._rows)
+        if not rows:
+            self._update_gauges()
+            return
+        pool = self.kv_pool
+        n = len(rows)
+        # The step batch is PINNED at max_batch (pad rows carry
+        # all-trash tables): the active-row count changes at every
+        # join/retire boundary, so bucketing it would recompile the
+        # hottest program in the server over and over — one static
+        # width per table bucket instead.
+        B = self.max_batch
+        T = next_pow2(max(r.n_blocks for r in rows))
+        tables = numpy.zeros((B, T), numpy.int32)
+        pos = numpy.zeros(B, numpy.int32)
+        tok = numpy.zeros(B, numpy.int32)
+        gen_idx = numpy.zeros(B, numpy.int32)
+        temps = numpy.zeros(B, numpy.float32)
+        seeds = numpy.zeros(B, numpy.uint32)
+        for at, row in enumerate(rows):
+            req = row.req
+            tables[at, :row.n_blocks] = row.table
+            pos[at] = row.pos
+            tok[at] = row.tok
+            gen_idx[at] = len(row.gen)
+            temps[at] = req.temperature
+            seeds[at] = (req.seed + row.row_idx) & 0xFFFFFFFF
+        t0 = time.monotonic()
+        try:
+            new_tok = self.model.paged_step(pool, tables, pos, tok,
+                                            gen_idx, temps, seeds)
+        except Exception as e:
+            self.exception("paged decode step failed")
+            self._paged_wreck(rows, e)
+            return
+        dt = time.monotonic() - t0
+        self.stats.observe_batch("decode", n, dt)
+        self.stats.observe_latency("itl.decode", dt)
+        self._note_ewma("decode", dt)
+        self.stats.note_tokens(n)
+        self.stats.incr("tokens.generated", n)
+        finished = []
+        for at, row in enumerate(rows):
+            row.tok = int(new_tok[at])
+            row.gen.append(row.tok)
+            row.pos += 1
+            if len(row.gen) >= row.req.max_new:
+                finished.append(row)
+        for row in finished:
+            self._retire_row(row)
+        self._update_gauges()
+
+    def _release_row_blocks(self, row):
+        """Releases a row's table exactly once (claimed under the
+        engine lock) — a row can reach both the retire and fail
+        paths (e.g. a stop() that outwaits a stuck device call
+        racing the step's own retirement), and a double release
+        would corrupt the pool's refcounts."""
+        with self._cond:
+            table, row.table = row.table, None
+        if table is not None:
+            self.kv_pool.release(table)
+            return True
+        return False
+
+    def _retire_row(self, row):
+        """A row met its budget: free its blocks NOW (the pool is
+        the scarce resource; the next waiting request can take them
+        at this very boundary) and complete the request once its
+        last row lands.  Claiming the table, leaving the batch, and
+        the reservation/rows_done accounting are ONE locked step, so
+        a concurrent _fail_req can never double-count the row."""
+        req = row.req
+        with self._cond:
+            table, row.table = row.table, None
+            if table is None:
+                return  # already retired/failed elsewhere
+            if row in self._rows:
+                self._rows.remove(row)
+            self._kv_committed -= req.kv_commit // req.rows
+            req.rows_done += 1
+        self.kv_pool.release(table)
+        req.row_results[row.row_idx] = row.gen
+        if req.rows_done < req.rows:
+            return
+        gen = numpy.asarray(req.row_results, dtype=numpy.int32)
+        req.result = numpy.concatenate([req.tokens, gen], axis=1)
+        req.event.set()
+
+    def _fail_req(self, req, error):
+        """Error path: drop every row of the request from the decode
+        batch, free blocks + reservation, wake the submitter."""
+        tables = []
+        with self._cond:
+            mine = [r for r in self._rows if r.req is req]
+            for row in mine:
+                self._rows.remove(row)
+                table, row.table = row.table, None
+                if table is not None:
+                    tables.append(table)
+            self._kv_committed -= req.kv_commit * \
+                (req.rows - req.rows_done) // req.rows
+        for table in tables:
+            self.kv_pool.release(table)
+        if req.error is None:
+            req.error = error
+        req.event.set()
+
+    def _paged_wreck(self, rows, error):
+        """A paged device call failed: the pool's storage may be in
+        an undefined (half-donated) state, so fail every request that
+        had rows in flight and rebuild the pool from scratch —
+        correctness over cached prefixes."""
+        for req in {row.req for row in rows} | \
+                {row.req for row in self._rows}:
+            self._fail_req(req, error)
+        pool = self.kv_pool
+        self.stats.incr("kv.pool.resets")
+        self.kv_pool = self.model.make_kv_pool(pool.n_blocks,
+                                               pool.block_size)
+        self._update_gauges()
+
+    def _update_gauges(self):
+        pool = self.kv_pool
+        if pool is None:
+            return
+        occ = pool.occupancy()
+        self.stats.set_gauge("kv_blocks_used", occ["blocks_used"])
+        self.stats.set_gauge("kv_blocks_total", occ["blocks_total"])
+        self.stats.set_gauge("decode_rows", len(self._rows))
+
     # -- warmup ------------------------------------------------------------
 
     #: The HTTP handler's default max_new_tokens — warmup must cover
@@ -378,9 +896,10 @@ class ServingEngine(Logger):
         """Precompiles the bucket grid so the first real request
         never pays an XLA compile.  Dense classify models warm the
         batch-bucket dim; LM artifacts (``max_position`` known) warm
-        the (batch × prompt × decode) bucket grid too, with the
-        decode span covering the handler's default budget.  Returns
-        the number of entry points warmed."""
+        the generate grid — the (batch × prompt × decode) dense
+        buckets, or under paged decode the (batch × chunk × table)
+        extend programs plus the (batch × table) step programs.
+        Returns the number of entry points warmed."""
         manifest = getattr(self.model, "manifest", None)
         compiles = 0
         self._grow_compile_cache(longest_prompt, max_new)
@@ -402,19 +921,25 @@ class ServingEngine(Logger):
                                  "failed: %s", b, e)
                     break
         limit = self._max_position
-        gen_b = getattr(self.model, "generate_bucketed", None)
-        if limit and gen_b is not None:
-            if max_new is None:
-                max_new = self.DEFAULT_MAX_NEW
-            longest = longest_prompt or max(1, limit - max_new)
+        if not limit:
+            self.stats.incr("warmup.compiles", compiles)
+            return compiles
+        if max_new is None:
+            max_new = self.DEFAULT_MAX_NEW
+        longest = longest_prompt or max(1, limit - max_new)
+        if self.paged:
+            compiles += self._warmup_paged(longest, max_new)
+        elif getattr(self.model, "generate_bucketed", None) \
+                is not None:
             for b, s, m in self.policy.grid(longest, max_new):
                 s = min(s, limit)
                 prompts = numpy.zeros((b, s), numpy.int32)
                 lengths = numpy.ones(b, numpy.int32)
                 try:
-                    gen_b(prompts, lengths, m,
-                          numpy.zeros(b, numpy.float32),
-                          numpy.zeros(b, numpy.int64))
+                    self.model.generate_bucketed(
+                        prompts, lengths, m,
+                        numpy.zeros(b, numpy.float32),
+                        numpy.zeros(b, numpy.int64))
                     compiles += 1
                 except Exception as e:
                     self.warning("generate warmup (%d, %d, %d) "
@@ -424,6 +949,68 @@ class ServingEngine(Logger):
         if compiles:
             self.info("warmup precompiled %d bucket entry points",
                       compiles)
+        return compiles
+
+    def _paged_warm_keys(self, longest, max_new):
+        """The paged warmup grid: extend keys (batch, chunk, table)
+        for every (batch, prompt, decode) bucket triple, and step
+        keys for EVERY power-of-two table width up to the pool's
+        full span — a runtime table bucket is always one of those,
+        whatever mix of lengths is in flight, so the hot step
+        program never pays a first-request compile.  (Prefix-hit
+        extends — short chunk, long table — can still miss; they pay
+        one compile each on first occurrence.)"""
+        pool = self._ensure_pool()
+        limit = self._max_position
+        extends = []
+        seen = set()
+        for b in self.policy.batch_buckets():
+            for s in self.policy.prompt_buckets(min(longest, limit)):
+                s = min(s, limit)
+                for m in self.policy.new_buckets(max_new):
+                    T = next_pow2(pool.blocks_for(
+                        min(s + m, limit)))
+                    if (b, s, T) not in seen:
+                        seen.add((b, s, T))
+                        extends.append((b, s, T))
+        T_full = next_pow2(pool.blocks_for(limit))
+        steps = []
+        T = 1
+        while T <= T_full:
+            steps.append(T)
+            T *= 2
+        return extends, steps
+
+    def _warmup_paged(self, longest, max_new):
+        """Warm the paged grid against the trash block — pad
+        geometry, junk content, so warmup costs compiles, not pool
+        blocks."""
+        pool = self._ensure_pool()
+        compiles = 0
+        extends, steps = self._paged_warm_keys(longest, max_new)
+        try:
+            for b, s, T in extends:
+                self.model.paged_extend(
+                    pool, numpy.zeros((b, T), numpy.int32),
+                    numpy.zeros((b, s), numpy.int32),
+                    numpy.zeros(b, numpy.int32),
+                    numpy.ones(b, numpy.int32),
+                    numpy.zeros(b, numpy.float32),
+                    numpy.zeros(b, numpy.uint32))
+                compiles += 1
+            for T in steps:
+                self.model.paged_step(
+                    pool,
+                    numpy.zeros((self.max_batch, T), numpy.int32),
+                    numpy.zeros(self.max_batch, numpy.int32),
+                    numpy.zeros(self.max_batch, numpy.int32),
+                    numpy.zeros(self.max_batch, numpy.int32),
+                    numpy.zeros(self.max_batch, numpy.float32),
+                    numpy.zeros(self.max_batch, numpy.uint32))
+                compiles += 1
+        except Exception as e:
+            self.warning("paged warmup failed after %d compiles: %s",
+                         compiles, e)
         return compiles
 
     def _grow_compile_cache(self, longest_prompt, max_new):
@@ -439,7 +1026,12 @@ class ServingEngine(Logger):
         if limit:
             m = self.DEFAULT_MAX_NEW if max_new is None else max_new
             longest = longest_prompt or max(1, limit - m)
-            needed += len(self.policy.grid(longest, m))
+            if self.paged:
+                # the exact warm key sets + the copy program.
+                extends, steps = self._paged_warm_keys(longest, m)
+                needed += len(extends) + len(steps) + 1
+            else:
+                needed += len(self.policy.grid(longest, m))
         needed += 8  # non-bucketed generate() headroom
         if cache.capacity < needed:
             self.info("compile cache capacity %d -> %d (warmup grid)",

@@ -2,12 +2,18 @@
 
 Reuses the PR-1 counter idiom (:class:`veles_tpu.resilience
 .ResilienceStats` — a thread-safe named-counter registry) and adds
-the two serving-specific shapes counters can't carry: a
-batch-occupancy histogram (how full do coalesced batches run?) and
-p50/p99 latency over a sliding window per endpoint.
+the serving-specific shapes counters can't carry: a batch-occupancy
+histogram (how full do coalesced batches run?), p50/p99 latency over
+a sliding window per endpoint (including TTFT and inter-token
+latency for the paged decode path), point-in-time gauges (KV-pool
+occupancy), and a sliding-window decode token rate — the same
+numbers the ``bench.py --serve`` soak reports, live.
 """
 
+import collections
 import threading
+import time
+import weakref
 
 from ..resilience import ResilienceStats
 
@@ -50,11 +56,18 @@ class ServingStats(object):
     """Counters + occupancy histogram + latency windows for one
     engine.  ``snapshot()`` is the ``/stats`` payload body."""
 
+    #: Seconds of history behind ``decode_tok_per_sec`` — long
+    #: enough to smooth step jitter, short enough that the rate
+    #: reflects the CURRENT load, not the whole process lifetime.
+    RATE_WINDOW = 30.0
+
     def __init__(self, window=512):
         self.counters = ResilienceStats()
         self._occupancy = {}  # rows-per-executed-batch -> count
         self._latency = {}  # kind -> LatencyWindow
         self._window = int(window)
+        self._gauges = {}  # name -> latest value (pool occupancy &c)
+        self._tokens = collections.deque()  # (monotonic, n) events
         self._lock = threading.Lock()
 
     def incr(self, name, n=1):
@@ -78,14 +91,50 @@ class ServingStats(object):
     def observe_request(self, kind, latency_seconds):
         """One completed request (queue wait + device time)."""
         self.counters.incr("requests.%s" % kind)
-        key = "request.%s" % kind
+        self.observe_latency("request.%s" % kind, latency_seconds)
+
+    def observe_latency(self, key, seconds):
+        """One sample into the named latency window — the paged
+        decode path feeds ``ttft.generate`` (submit → first token)
+        and ``itl.decode`` (one decode step = one inter-token gap
+        for every riding row) through this."""
         with self._lock:
             win = self._latency.get(key)
             if win is None:
                 win = self._latency[key] = LatencyWindow(self._window)
-        win.observe(latency_seconds)
+        win.observe(seconds)
+
+    def set_gauge(self, name, value):
+        """Point-in-time value (KV blocks used, active decode rows);
+        the latest write wins and rides ``snapshot()``."""
+        with self._lock:
+            self._gauges[name] = value
+
+    def note_tokens(self, n):
+        """``n`` tokens generated now — feeds the sliding-window
+        ``decode_tok_per_sec`` rate."""
+        now = time.monotonic()
+        with self._lock:
+            self._tokens.append((now, int(n)))
+            self._prune_tokens_locked(now)
+
+    def _prune_tokens_locked(self, now):
+        cutoff = now - self.RATE_WINDOW
+        while self._tokens and self._tokens[0][0] < cutoff:
+            self._tokens.popleft()
+
+    def tokens_per_second(self):
+        now = time.monotonic()
+        with self._lock:
+            self._prune_tokens_locked(now)
+            if not self._tokens:
+                return 0.0
+            total = sum(n for _, n in self._tokens)
+            span = max(now - self._tokens[0][0], 0.1)
+        return total / span
 
     def snapshot(self):
+        rate = self.tokens_per_second()
         with self._lock:
             occupancy = {str(k): v for k, v
                          in sorted(self._occupancy.items())}
@@ -94,10 +143,57 @@ class ServingStats(object):
                        "p50_ms": _ms(win.percentile(50)),
                        "p99_ms": _ms(win.percentile(99))}
                 for kind, win in self._latency.items()}
-        return {"counters": self.counters.snapshot(),
-                "batch_occupancy": occupancy,
-                "latency": latency}
+            gauges = dict(self._gauges)
+        out = {"counters": self.counters.snapshot(),
+               "batch_occupancy": occupancy,
+               "latency": latency,
+               "decode_tok_per_sec": round(rate, 2)}
+        if gauges:
+            out["gauges"] = gauges
+        return out
 
 
 def _ms(seconds):
     return None if seconds is None else round(seconds * 1000.0, 3)
+
+
+#: Live engines in this process (weak: a dropped engine vanishes on
+#: its own) — the launcher heartbeat pulls a compact serving summary
+#: from here so web_status shows tok/s and pool occupancy next to the
+#: training rows, without the serving and training subsystems holding
+#: references to each other.
+_LIVE_ENGINES = weakref.WeakSet()
+
+
+def register_engine(engine):
+    _LIVE_ENGINES.add(engine)
+
+
+def unregister_engine(engine):
+    _LIVE_ENGINES.discard(engine)
+
+
+def live_serving_summary():
+    """A small aggregate across this process's running engines for
+    the web-status ``serving`` row, or None when nothing serves."""
+    engines = [e for e in list(_LIVE_ENGINES)
+               if getattr(e, "_thread", None) is not None]
+    if not engines:
+        return None
+    out = {"engines": len(engines),
+           "tok_per_sec": round(sum(
+               e.stats.tokens_per_second() for e in engines), 2),
+           "queue_depth": sum(
+               e.queue_depth_now() for e in engines)}
+    used = total = 0
+    for e in engines:
+        pool = getattr(e, "kv_pool", None)
+        if pool is None:
+            continue
+        occ = pool.occupancy()
+        used += occ["blocks_used"]
+        total += occ["blocks_total"]
+    if total:
+        out["kv_blocks_used"] = used
+        out["kv_blocks_total"] = total
+    return out
