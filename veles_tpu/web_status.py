@@ -172,6 +172,13 @@ class WebStatusServer(JsonHttpServer):
                 "<tr><th>comms</th><td>%s</td></tr>" %
                 esc(json.dumps(comms, sort_keys=True))
                 if isinstance(comms, dict) and comms else "")
+            # Serving row: decode tok/s + paged KV-pool occupancy
+            # from any in-process serving engine riding the beat.
+            serving = info.get("serving")
+            serving_row = (
+                "<tr><th>serving</th><td>%s</td></tr>" %
+                esc(json.dumps(serving, sort_keys=True))
+                if isinstance(serving, dict) and serving else "")
             # Training health (guardian heartbeat section): flag a
             # master that detected NaN/spike events prominently.
             health = info.get("health")
@@ -188,12 +195,14 @@ class WebStatusServer(JsonHttpServer):
                 "<table><tr><th>mode</th><td>%s</td></tr>"
                 "<tr><th>epoch</th><td>%s</td></tr>"
                 "<tr><th>runtime</th><td>%.0f s</td></tr>"
-                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s</table>" %
+                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s%s"
+                "</table>" %
                 (esc(info.get("workflow", "?")), esc(mid),
                  esc(info.get("mode", "?")), esc(info.get("epoch", "?")),
                  runtime,
                  esc(json.dumps(info.get("metrics", {}))),
-                 health_row, resilience_row, comms_row) +
+                 health_row, resilience_row, comms_row,
+                 serving_row) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
                  "</th><th>jobs</th><th>jobs/s</th></tr>%s</table>"
                  % wtable if workers else "") +
