@@ -70,6 +70,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 A100_ALEXNET_IMG_PER_SEC = 10000.0
 A100_MLP_IMG_PER_SEC = 1.5e6
 
+#: Every flag bench.py recognizes (argv is parsed ad-hoc, not via
+#: argparse) — the docs-consistency test cross-checks documentation
+#: references against this, so a flag mentioned in docs/*.md must
+#: exist here or in a real parser.
+BENCH_FLAGS = ("--mlp", "--lm", "--lm-toy", "--serve", "--streamed",
+               "--streamed-jpeg", "--attn-stages", "--serve-streams",
+               "--serve-seconds", "--trace-out")
+
 # Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
 # or blocks gain <3% more.  The perf levers that got here: banded-
 # matmul LRN (~2× over shifted adds), bf16 activation stream, and
@@ -737,6 +745,49 @@ def measure(wf, epochs):
     return epochs * loader.total_samples / dt
 
 
+def trace_one_step(wf, path):
+    """Enables span tracing, drives fused dispatches until one
+    ``step.dispatch`` span lands, exports the Chrome trace to
+    ``path`` and returns the dispatch-wall milliseconds of that
+    step (--trace-out; docs/observability.md)."""
+    from veles_tpu.observability import tracing
+    tracing.enable()
+    tracing.clear()
+    loader = wf.loader
+
+    def dispatch_spans():
+        return [s for s in tracing.spans()
+                if s["name"] == "step.dispatch"]
+
+    for _ in range(4 * max(getattr(wf, "ticks_per_dispatch", 1), 1)):
+        loader.run()
+        if dispatch_spans():
+            break
+    tracing.export_chrome_trace(path)
+    spans = dispatch_spans()
+    tracing.reset()
+    if not spans:
+        return None
+    return round(spans[-1]["dur"] / 1000.0, 3)
+
+
+def attribution_fields():
+    """Live device-time/MFU gauge readings for the bench JSON line
+    (the BENCH_r06 per-stage attribution record)."""
+    from veles_tpu.observability import attribution
+    perf = attribution.perf_summary() or {}
+    dispatches = perf.get("dispatches") or 0
+    mean_ms = None
+    if dispatches:
+        mean_ms = round(perf["device_s_total"] / dispatches * 1e3, 3)
+    return {
+        "step_device_ms": perf.get("step_ms"),
+        "step_device_ms_mean": mean_ms,
+        "device_dispatches": dispatches,
+        "mfu_live": perf.get("mfu"),
+    }
+
+
 def main():
     if "--serve" in sys.argv:
         serve_bench(sys.argv)
@@ -808,6 +859,12 @@ def main():
         # the JSON line so per-stage attribution is in the record.
         stages = parse_attn_stages(sys.argv)
         apply_attn_stages(stages)
+        # MFU denominator for the live attribution gauge: the same
+        # v5e peak the analytic MFU below uses, so the two numbers
+        # are directly comparable on the JSON line.
+        from veles_tpu.config import root as _root
+        _root.common.observability.peak_tflops = \
+            TPU_V5E_PEAK_BF16_TFLOPS
         if toy:
             geom = dict(vocab=LM_TOY_VOCAB, seq=LM_TOY_SEQ,
                         embed=LM_TOY_EMBED, heads=LM_TOY_HEADS,
@@ -824,6 +881,11 @@ def main():
                         n_valid=LM_N_VALID)
             _, wf = build_lm()
         ips = measure(wf, epochs=2)
+        trace_out = next(
+            (a.split("=", 1)[1] for a in sys.argv
+             if a.startswith("--trace-out=")), None)
+        step_wall_ms = trace_one_step(wf, trace_out) \
+            if trace_out else None
         tokens_per_sec = ips * geom["seq"]
         # Validation sequences run forward-only (~1/3 of the train
         # FLOP cost); weight them accordingly in the FLOP accounting.
@@ -848,6 +910,12 @@ def main():
             "model_tflops_per_sec": round(tflops, 1),
             "mfu_vs_v5e_bf16_peak": round(mfu, 4),
             "attn_stages": list(stages),
+            # Per-stage attribution (BENCH_r06): wall ms of one
+            # traced dispatch, device ms + live-MFU gauges measured
+            # at the dispatch (observability.attribution).
+            "step_wall_ms": step_wall_ms,
+            "trace_out": trace_out,
+            **attribution_fields(),
         }))
         return
     if "--mlp" in sys.argv:

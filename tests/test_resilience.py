@@ -605,5 +605,5 @@ def test_print_stats_reports_resilience_events(caplog):
     resilience.stats.incr("server.drop", 2)
     wf = LedgerWorkflow(Launcher())
     with caplog.at_level(logging.INFO):
-        wf.print_stats()
+        wf.print_stats(flat=True)
     assert any("server.drop=2" in m for m in caplog.messages)

@@ -327,6 +327,21 @@ class Main(Logger, CommandLineBase):
             root.common.net.mode = "legacy"
         if args.net_require:
             root.common.net.require = True
+        # Observability knobs (observability.init_parser;
+        # docs/observability.md): --trace-out arms span tracing (the
+        # launcher exports at run end; workers enable via handshake),
+        # --xprof arms the jax.profiler capture window around the
+        # next N fused dispatches.
+        if args.trace_out:
+            root.common.observability.trace_out = args.trace_out
+            root.common.observability.trace = True
+            from .observability import tracing
+            tracing.enable(ring=args.trace_ring)
+        if args.xprof:
+            root.common.observability.xprof = args.xprof
+            from .observability import attribution
+            attribution.configure_xprof(args.xprof,
+                                        args.xprof_steps)
 
     def load(self, WorkflowClass, **kwargs):
         """``load`` closure passed to the module's run() hook

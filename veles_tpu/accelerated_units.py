@@ -232,6 +232,9 @@ class StepCompiler(object):
         self.persist_vectors = []  # evaluator outputs etc.
         self._compiled = None
         self._fingerprint = None
+        # Per-mode FLOP estimate for the live MFU gauge
+        # (observability.attribution); 0.0 = tried, unavailable.
+        self._step_flops_ = {}
 
     # -- graph analysis ----------------------------------------------------
 
@@ -555,6 +558,7 @@ class StepCompiler(object):
         self._param_vecs = param_vecs
         self._state_vecs = state_vecs
         self._fingerprint = self.fingerprint()
+        self._step_flops_ = {}
         self._compiled = True
 
     def invalidate(self):
@@ -566,7 +570,43 @@ class StepCompiler(object):
 
     # -- execution ---------------------------------------------------------
 
+    def _maybe_flops(self, key, fn, *args):
+        """Per-dispatch FLOP estimate for the live MFU gauge, cached
+        per compile under ``key`` — ("block", K) for block mode: a
+        remainder block (epoch length % ticks_per_dispatch) is a
+        different program with different FLOPs, and reusing the
+        first-seen estimate would skew MFU for the rest of the run.
+        Estimation re-traces the step once (XLA HLO cost analysis,
+        no recompile), so it runs only when a peak FLOP/s is known
+        for this device (the MFU denominator) — never on CPU test
+        hardware.  MUST run BEFORE the dispatch: lowering needs the
+        argument buffers donation invalidates."""
+        from .observability import attribution
+        if not attribution.enabled():
+            return None
+        cached = self._step_flops_.get(key)
+        if cached is not None:
+            return cached or None  # 0.0 = tried, unavailable
+        if attribution.peak_flops() is None:
+            self._step_flops_[key] = 0.0
+            return None
+        flops = attribution.estimate_flops(fn, *args)
+        self._step_flops_[key] = flops or 0.0
+        return flops
+
+    @staticmethod
+    def _sync_leaf(*trees):
+        """A small output leaf to ``block_until_ready`` on — every
+        output of one XLA computation completes together, so waiting
+        on any leaf times the whole dispatch."""
+        for tree in trees:
+            if tree:
+                return next(iter(tree.values()))
+        return None
+
     def execute(self, key=None, training=True):
+        from .observability import attribution
+        from .observability import tracing
         if not self._compiled or self.fingerprint() != self._fingerprint:
             self.compile()
         params = {n: v.devmem for n, v in self._param_vecs.items()}
@@ -576,26 +616,36 @@ class StepCompiler(object):
         if key is None:
             from . import prng
             key = prng.get().jax_key()
-        if training:
-            new_params, new_states, outputs, metrics = self._train(
-                params, states, batch, consts, key)
-            for n, v in self._param_vecs.items():
-                v.devmem = new_params[n]
-        else:
-            new_states, outputs, metrics = self._infer(
-                params, states, batch, consts, key)
+        mode = "train" if training else "infer"
+        flops = self._maybe_flops(
+            mode, self._train if training else self._infer,
+            params, states, batch, consts, key)
+        timer = attribution.begin_step(ticks=1, flops=flops)
+        with tracing.span("step.dispatch", mode=mode):
+            if training:
+                new_params, new_states, outputs, metrics = \
+                    self._train(params, states, batch, consts, key)
+                for n, v in self._param_vecs.items():
+                    v.devmem = new_params[n]
+            else:
+                new_states, outputs, metrics = self._infer(
+                    params, states, batch, consts, key)
         for n, v in self._state_vecs.items():
             v.devmem = new_states[n]
         for vec in self.persist_vectors:
             pid = str(id(vec))
             if pid in outputs:
                 vec.devmem = outputs[pid]
+        attribution.end_step(timer,
+                             leaf=self._sync_leaf(metrics, new_states))
         return metrics
 
     def execute_block(self, blocks, training, key=None):
         """Dispatches K stacked ticks at once; ``blocks`` maps batch
         vector id → (K, ...) numpy/jax array."""
         import jax.numpy as jnp
+        from .observability import attribution
+        from .observability import tracing
         if not self._compiled or self.fingerprint() != self._fingerprint:
             self.compile()
         params = {n: v.devmem for n, v in self._param_vecs.items()}
@@ -604,13 +654,21 @@ class StepCompiler(object):
         if key is None:
             from . import prng
             key = prng.get().jax_key()
-        new_params, new_states = self._block(
-            params, states, blocks, consts, key,
-            jnp.float32(1.0 if training else 0.0))
+        ticks = next(iter(blocks.values())).shape[0] if blocks else 1
+        flag = jnp.float32(1.0 if training else 0.0)
+        flops = self._maybe_flops(("block", ticks), self._block,
+                                  params, states, blocks, consts,
+                                  key, flag)
+        timer = attribution.begin_step(ticks=ticks, flops=flops)
+        with tracing.span("step.dispatch", mode="block", ticks=ticks):
+            new_params, new_states = self._block(
+                params, states, blocks, consts, key, flag)
         for n, v in self._param_vecs.items():
             v.devmem = new_params[n]
         for n, v in self._state_vecs.items():
             v.devmem = new_states[n]
+        attribution.end_step(timer,
+                             leaf=self._sync_leaf(new_states))
         return {}
 
     # -- population mode (vmapped hyperparameter sweeps) -------------------

@@ -8,6 +8,7 @@ injection ``:302-307,438-442``, bounded reconnect attempts
 ``:488-507``, periodic power re-measurement ``:308-313``).
 """
 
+import collections
 import os
 import random
 import time
@@ -16,6 +17,7 @@ from . import resilience
 from .logger import Logger
 from .network_common import (Channel, connect, machine_id,
                              normalize_secret)
+from .observability import tracing
 from .resilience import (HandshakeRejected, ProtocolError,
                          RetryPolicy, WorkerHang, WorkerKilled)
 
@@ -25,6 +27,7 @@ WORKER_CAPS = {
     "tensor": True,        # tensor-framed messages
     "delta": True,         # delta weight sync (both directions)
     "block": True,         # multi-tick jobs (fused scan-block)
+    "trace": True,         # span shipping + clock-sync timestamps
     "codecs": ("none", "gzip"),
     "dtypes": ("fp32", "bf16"),
 }
@@ -147,6 +150,11 @@ class Client(Logger):
         self.id = None
         self.jobs_done = 0
         self._stop = False
+        #: Master-clock offset estimator (observability.tracing):
+        #: fed by the timestamps trace sessions carry on job-cycle
+        #: replies; shipped spans are re-timestamped onto the master
+        #: timeline with its best (minimum-RTT) estimate.
+        self.clock = tracing.ClockSync()
         #: Pipelined mode (reference --async-slave, client.py:293-341):
         #: job N+1 is requested BEFORE job N's update is sent, so the
         #: network round-trip overlaps local compute.
@@ -276,18 +284,52 @@ class Client(Logger):
         self.jobs_done += 1
         return result.get("update")
 
+    def _traced_job(self, msg, trace_on):
+        """Runs one job; on a trace session, wraps it in a
+        ``worker.step`` span parented to the master's dispatch span
+        and returns ``(update, spans)`` with the spans captured on
+        this thread re-timestamped onto the master clock.
+        ``spans=None`` outside trace sessions."""
+        tctx = msg.get("trace") if trace_on else None
+        if not tctx or not tracing.enabled():
+            return self._run_job(msg["data"]), None
+        with tracing.capture() as captured:
+            with tracing.attach(tctx.get("trace_id"),
+                                tctx.get("parent")):
+                with tracing.span("worker.step", worker=self.id,
+                                  pid=os.getpid()):
+                    update = self._run_job(msg["data"])
+        return update, tracing.shift(captured, self.clock.offset)
+
+    def _update_msg(self, update, spans):
+        out = {"cmd": "update", "data": update}
+        if spans is not None:
+            out["spans"] = spans
+            out["clock"] = self.clock.state()
+        return out
+
     def _job_cycle_async(self, chan):
         """Pipelined cycle (reference: client.py:293-341): the next
         job request is on the wire while the current job computes, so
         the worker never idles on master latency.  Replies arrive in
         request order (one TCP stream, serial server handler), so a
         simple state walk suffices — no reply-id matching needed."""
+        trace_on = bool(chan.proto.get("trace"))
+        # Pipelined requests: pair each reply with ITS request's send
+        # time (replies arrive in request order) for clock sampling.
+        sent_at = collections.deque()
         chan.send({"cmd": "job_request"})
+        sent_at.append(time.time())
         while not self._stop:
             msg = chan.recv()
             if msg is None:
                 return False
+            recv_ts = time.time()
             cmd = msg.get("cmd")
+            if cmd in ("job", "no_job", "bye") and sent_at:
+                send_ts = sent_at.popleft()
+                if trace_on and "ts" in msg:
+                    self.clock.sample(send_ts, msg["ts"], recv_ts)
             if cmd == "bye":
                 return True
             if cmd == "update_ack":
@@ -295,6 +337,7 @@ class Client(Logger):
             if cmd == "no_job":
                 self._nojob_backoff()
                 chan.send({"cmd": "job_request"})
+                sent_at.append(time.time())
                 continue
             if cmd != "job":
                 continue
@@ -304,8 +347,9 @@ class Client(Logger):
             inj.check("worker.job")
             # Pipeline: request N+1 BEFORE computing N.
             chan.send({"cmd": "job_request"})
-            update = self._run_job(msg["data"])
-            chan.send({"cmd": "update", "data": update})
+            sent_at.append(time.time())
+            update, spans = self._traced_job(msg, trace_on)
+            chan.send(self._update_msg(update, spans))
             self._maybe_remeasure_power(chan)
         return True
 
@@ -355,6 +399,11 @@ class Client(Logger):
         # key — the session stays pickle-compat end to end.
         proto = reply.get("proto") or {}
         chan.set_proto(proto)
+        if proto.get("trace") and not tracing.enabled():
+            # The master is tracing and asked for our spans: turn the
+            # local collector on (the negotiated trace dialect is the
+            # worker-side opt-in; no flag needed on the worker).
+            tracing.enable()
         note = getattr(self.workflow, "note_net_proto", None)
         if note is not None:
             note(proto)
@@ -370,11 +419,17 @@ class Client(Logger):
 
     def _job_cycle(self, chan):
         """Returns True on orderly completion."""
+        trace_on = bool(chan.proto.get("trace"))
         while not self._stop:
+            send_ts = time.time()
             chan.send({"cmd": "job_request"})
             msg = chan.recv()
             if msg is None:
                 return False
+            if trace_on and "ts" in msg:
+                # Request/reply timestamp pair → one clock-offset
+                # sample (minimum-RTT sample wins; see ClockSync).
+                self.clock.sample(send_ts, msg["ts"], time.time())
             cmd = msg.get("cmd")
             if cmd == "bye":
                 return True
@@ -387,8 +442,8 @@ class Client(Logger):
             inj = self._injector_()
             inj.tick("job")
             inj.check("worker.job")
-            update = self._run_job(msg["data"])
-            chan.send({"cmd": "update", "data": update})
+            update, spans = self._traced_job(msg, trace_on)
+            chan.send(self._update_msg(update, spans))
             ack = chan.recv()
             if ack is None:
                 return False

@@ -164,6 +164,7 @@ CONTRIBUTING_MODULES = (
     "veles_tpu.guardian",
     "veles_tpu.loader.base",
     "veles_tpu.network_common",
+    "veles_tpu.observability",
     "veles_tpu.ops.attention",
     "veles_tpu.restful",
     "veles_tpu.snapshotter",

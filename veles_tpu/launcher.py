@@ -341,6 +341,23 @@ class Launcher(Logger):
                             sid, desc.jobs_done, desc.jobs_per_second)
                         for sid, desc in sorted(slaves.items())))
             self.workflow.print_stats()
+            self._export_trace()
+
+    def _export_trace(self):
+        """Writes the collected spans as Chrome trace-event JSON when
+        ``--trace-out`` armed tracing (master/standalone only — a
+        worker's spans already rode the job protocol home)."""
+        from .observability import tracing
+        path = config_get(root.common.observability.trace_out, None)
+        if not path or self.is_slave or not tracing.enabled():
+            return
+        try:
+            obj = tracing.export_chrome_trace(path)
+        except OSError as e:
+            self.warning("cannot write trace %s: %s", path, e)
+            return
+        self.info("trace -> %s (%d events)", path,
+                  len(obj["traceEvents"]))
 
     def on_workflow_finished(self):
         self._finished.set()
@@ -427,11 +444,21 @@ class Launcher(Logger):
             payload["comms"] = net
         # Resilience events (retries, drops, blacklists, crashes,
         # resumes): operators see degradation, not just survive it.
-        # net.* already rides the comms row — don't ship it twice.
+        # net.* already rides the comms row and device.* rides the
+        # perf row — don't ship either twice.
         events = {k: v for k, v in events.items()
-                  if not k.startswith("net.")}
+                  if not k.startswith(("net.", "device."))}
         if events:
             payload["resilience"] = events
+        # Perf row (docs/observability.md): live device-time and MFU
+        # attribution of the fused step, measured at the dispatch.
+        try:
+            from .observability import attribution
+            perf = attribution.perf_summary()
+        except Exception:
+            perf = None
+        if perf:
+            payload["perf"] = perf
         # Serving row: any live ServingEngine in this process (an
         # in-workflow RESTfulAPI unit) ships its decode tok/s, queue
         # depth, and KV-pool occupancy so the soak's numbers are

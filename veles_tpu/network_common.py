@@ -30,6 +30,8 @@ import time
 import uuid
 import zlib
 
+from .observability import tracing as _tracing
+
 _HEADER = struct.Struct(">QB")  # payload length, flags
 _FLAG_GZIP = 1
 #: Tensor-framed body (see :func:`encode_tensor_parts`).  Never sent
@@ -540,20 +542,22 @@ class Channel(object):
     def encode(self, obj):
         """The expensive half of :meth:`send` (serialize + compress),
         safe to run outside any lock; pair with :meth:`send_parts`."""
-        return encode_message(obj, codec=self.codec,
-                              tensor=self.tensor_mode)
+        with _tracing.span("net.serialize"):
+            return encode_message(obj, codec=self.codec,
+                                  tensor=self.tensor_mode)
 
     def send_parts(self, flags, parts):
         """The socket half of :meth:`send`: MAC + sequence + sendall.
         Serialized per channel — two threads interleaving parts of
         different frames would corrupt the stream."""
         self._injector().check("net.send")
-        with self._send_lock:
-            send_parts(self.sock, flags, parts, self.secret,
-                       nonce=self.nonce,
-                       seq=self.send_seq if self.secret else None)
-            if self.secret is not None:
-                self.send_seq += 1
+        with _tracing.span("net.send"):
+            with self._send_lock:
+                send_parts(self.sock, flags, parts, self.secret,
+                           nonce=self.nonce,
+                           seq=self.send_seq if self.secret else None)
+                if self.secret is not None:
+                    self.send_seq += 1
 
     def send(self, obj):
         self.send_parts(*self.encode(obj))

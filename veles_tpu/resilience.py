@@ -139,33 +139,48 @@ class InjectedSnapshotCorruption(InjectedFault):
 # -- stats -----------------------------------------------------------------
 
 class ResilienceStats(object):
-    """Thread-safe named event counters.  Cheap enough to sprinkle on
-    every failure path; surfaced through launcher heartbeats and
-    ``Workflow.print_stats``."""
+    """Thread-safe named event counters — the PR-1 API every call
+    site and test uses (``incr``/``get``/``snapshot``/``reset``),
+    now a thin shim over a typed
+    :class:`~veles_tpu.observability.metrics.MetricsRegistry`: each
+    name is a Counter series, so everything incremented here is also
+    scrapeable as Prometheus text at ``GET /metrics`` without
+    touching a single increment site.  Surfaced through launcher
+    heartbeats and ``Workflow.print_stats``."""
 
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counts = {}
+    def __init__(self, registry=None):
+        if registry is None:
+            from .observability.metrics import MetricsRegistry
+            registry = MetricsRegistry()
+        self.registry = registry
 
     def incr(self, name, n=1):
-        with self._lock:
-            self._counts[name] = self._counts.get(name, 0) + n
+        self.registry.counter(name).inc(n)
 
     def get(self, name):
-        with self._lock:
-            return self._counts.get(name, 0)
+        counter = self.registry.peek(name)
+        return counter.value if counter is not None else 0
 
     def snapshot(self):
-        with self._lock:
-            return dict(self._counts)
+        """name → value over the counters (the historical flat-dict
+        shape; gauges/histograms sharing the registry stay out)."""
+        return self.registry.counters_snapshot()
 
     def reset(self):
-        with self._lock:
-            self._counts.clear()
+        # Counters only: gauges/histograms sharing the registry
+        # (device attribution, serving latency windows) belong to
+        # their own subsystems — a counter reset must not wipe them.
+        self.registry.reset(kind="counter")
 
 
-#: The process-wide resilience event registry.
-stats = ResilienceStats()
+def _global_registry():
+    from .observability.metrics import registry
+    return registry
+
+
+#: The process-wide resilience event registry, shimmed onto the
+#: process metrics registry (observability.metrics.registry).
+stats = ResilienceStats(registry=_global_registry())
 
 #: prng registry key for the resilience jitter stream — distinct from
 #: the model/loader generators (0, 1, …) so retry jitter never

@@ -158,6 +158,10 @@ class ModelServer(JsonHttpServer):
                     })
                 elif self.path == "/stats":
                     self.reply(200, outer.stats_payload())
+                elif self.path == "/metrics":
+                    from .observability.metrics import CONTENT_TYPE
+                    self.reply(200, outer.metrics_text(),
+                               CONTENT_TYPE)
                 else:
                     self.reply(404, {"error": "not found"})
 
@@ -318,6 +322,25 @@ class ModelServer(JsonHttpServer):
             payload["rate_limit"] = {"rate": self.limiter.rate,
                                      "clients": len(self.limiter)}
         return payload
+
+    def metrics_text(self):
+        """``GET /metrics``: Prometheus text exposition of the
+        process registry (net.*, chaos.*, device MFU gauges — the
+        resilience shim feeds it) plus this engine's serving registry
+        (request/batch counters, latency histograms, KV-pool gauges),
+        with the derived gauges refreshed at scrape time
+        (docs/observability.md)."""
+        from .observability import metrics as obs_metrics
+        stats = self.engine.stats
+        stats.refresh_gauges()
+        stats.set_gauge("queue_depth", self.engine.queue_depth_now())
+        pool = self.engine.kv_pool
+        if pool is not None:
+            occ = pool.occupancy()
+            stats.set_gauge("kv_blocks_used", occ["blocks_used"])
+            stats.set_gauge("kv_blocks_total", occ["blocks_total"])
+        return obs_metrics.render_prometheus(
+            [obs_metrics.registry, stats.registry])
 
     def _spin_up(self):
         self.engine.start()

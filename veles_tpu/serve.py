@@ -18,7 +18,8 @@ def main(argv=None):
         prog="veles_tpu.serve",
         description="Serve an exported veles_tpu model over HTTP "
                     "(POST /api, POST /api/generate, GET /health, "
-                    "GET /stats)")
+                    "GET /stats, GET /metrics Prometheus "
+                    "exposition)")
     parser.add_argument("artifact", help="model .veles.tgz path")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8180)

@@ -60,6 +60,10 @@ def negotiate_protocol(hello, cfg=None):
             "dtype": config_get(root.common.net.dtype, "fp32"),
             "job_ticks": config_get(root.common.net.job_ticks, 1),
             "require": config_get(root.common.net.require, False),
+            # None = derive from the live tracing state (--trace-out
+            # flips it on); an explicit config value wins.
+            "trace": config_get(root.common.observability.trace,
+                                None),
         }
     theirs = hello.get("proto") or {}
     if not theirs.get("tensor") or cfg.get("mode") == "legacy":
@@ -81,7 +85,7 @@ def negotiate_protocol(hello, cfg=None):
     ticks = int(cfg.get("job_ticks") or 1)
     if not theirs.get("block"):
         ticks = 1
-    return {
+    proto = {
         "tensor": True,
         "delta": bool(theirs.get("delta")),
         "codec": codec,
@@ -89,7 +93,19 @@ def negotiate_protocol(hello, cfg=None):
         "codec_threshold": cfg.get("codec_threshold"),
         "dtype": dtype,
         "ticks": max(1, ticks),
-    }, None
+    }
+    # Span tracing (docs/observability.md): when the master traces
+    # and the worker advertises the capability, job frames carry
+    # clock-sync timestamps + trace context and updates carry the
+    # worker's spans.  Old peers never see the fields (the key is
+    # simply absent — pickle-compat fallback).
+    want_trace = cfg.get("trace")
+    if want_trace is None:
+        from .observability import tracing
+        want_trace = tracing.enabled()
+    if want_trace and theirs.get("trace"):
+        proto["trace"] = True
+    return proto, None
 
 
 class SlaveDescription(object):
@@ -440,10 +456,22 @@ class Server(Logger):
                 self._drop(desc)
 
     def _message_loop(self, chan, desc):
+        from .observability import tracing
+        # Trace dialect for this session (handshake-negotiated):
+        # replies carry clock-sync timestamps, jobs carry trace
+        # context, and updates bring the worker's spans home.  Open
+        # dispatch spans are FIFO — a pipelined worker can hold more
+        # than one job in flight.
+        trace_on = bool(chan.proto.get("trace"))
+        open_dispatches = []
         while not self._stop.is_set():
             msg = self._recv_or_none(chan)
             if msg is None:
+                for sp in open_dispatches:
+                    sp.set(dropped=True)
+                    sp.finish()
                 return
+            recv_wall = time.time()
             cmd = msg.get("cmd")
             if cmd == "job_request":
                 if desc.blacklisted:
@@ -457,21 +485,68 @@ class Server(Logger):
                     # the connection outright, server.py:630-635).
                     return
                 if desc.paused:
-                    chan.send({"cmd": "no_job", "retry": True})
+                    chan.send(self._stamp({"cmd": "no_job",
+                                           "retry": True}, trace_on,
+                                          recv_wall))
                     continue
+                # The dispatch window: opens BEFORE job generation
+                # (the master-side share of the job's latency belongs
+                # inside it), closes when the worker's update has
+                # been folded — on one aligned timeline it strictly
+                # encloses the worker.step span.  Detached: pipelined
+                # workers hold overlapping windows on this thread,
+                # and stack nesting would chain siblings into
+                # parent/child; children attach explicitly below.
+                sp = tracing.begin("server.dispatch", detached=True,
+                                   worker=desc.id) \
+                    if trace_on and tracing.enabled() else None
                 job = self._generate_job(desc)
                 if job is None:
+                    if sp is not None:
+                        sp.cancel()
                     if self._maybe_finished():
                         chan.send({"cmd": "bye"})
                         return
-                    chan.send({"cmd": "no_job", "retry": True})
+                    chan.send(self._stamp({"cmd": "no_job",
+                                           "retry": True}, trace_on,
+                                          recv_wall))
                 else:
                     desc.state = "WORK"
                     desc.job_started = time.time()
-                    self._send_job(chan, job)
+                    if sp is None:
+                        self._send_job(chan, job, None)
+                    else:
+                        open_dispatches.append(sp)
+                        extra = self._stamp(
+                            {"trace": {"trace_id": sp.trace_id,
+                                       "parent": sp.id}},
+                            True, recv_wall)
+                        # net.serialize/net.send of THIS job nest
+                        # under THIS dispatch window.
+                        with tracing.attach(sp.trace_id, sp.id):
+                            self._send_job(chan, job, extra)
             elif cmd == "update":
-                self._apply_update(desc, msg["data"])
-                chan.send({"cmd": "update_ack"})
+                if trace_on:
+                    spans = msg.get("spans")
+                    if spans:
+                        tracing.ingest(spans,
+                                       proc="worker:%s" % desc.id)
+                # Replies arrive in dispatch order (one TCP stream,
+                # serial handler): this update answers the OLDEST
+                # open window — fold under it, then close it.
+                owner = open_dispatches.pop(0) if open_dispatches \
+                    else None
+                if owner is not None:
+                    with tracing.attach(owner.trace_id, owner.id):
+                        with tracing.span("net.fold",
+                                          worker=desc.id):
+                            self._apply_update(desc, msg["data"])
+                    owner.finish()
+                else:
+                    with tracing.span("net.fold", worker=desc.id):
+                        self._apply_update(desc, msg["data"])
+                chan.send(self._stamp({"cmd": "update_ack"},
+                                      trace_on, recv_wall))
                 if self._maybe_finished():
                     chan.send({"cmd": "bye"})
                     return
@@ -484,14 +559,33 @@ class Server(Logger):
 
     # -- workflow bridging -------------------------------------------------
 
-    def _send_job(self, chan, job):
+    @staticmethod
+    def _stamp(msg, trace_on, recv_wall):
+        """Adds the clock-sync timestamp to a reply (trace sessions
+        only): the worker pairs it with its local send/recv times
+        for the NTP-style offset estimate aligning its spans to the
+        master timeline.  The stamp is the MIDPOINT of request
+        receipt and reply build — NTP's (t2+t3)/2 — so server-side
+        processing (job generation can take a while) does not bias
+        the estimate."""
+        if trace_on:
+            msg["ts"] = (recv_wall + time.time()) / 2.0
+        return msg
+
+    def _send_job(self, chan, job, extra=None):
         """Serializes AND sends one job — called with the workflow
         lock NOT held.  The lock split matters: serializing a
         params-sized job for a slow worker must never stall
         ``_apply_update`` from the others (``_generate_job`` holds
         the lock only for the bookkeeping + host-side array
-        snapshot)."""
-        chan.send_parts(*self._serialize_job(chan, job))
+        snapshot).  ``extra`` carries the negotiated trace fields
+        (context + timestamp) at the message level."""
+        if extra:
+            msg = {"cmd": "job", "data": job}
+            msg.update(extra)
+            chan.send_parts(*chan.encode(msg))
+        else:
+            chan.send_parts(*self._serialize_job(chan, job))
 
     def _serialize_job(self, chan, job):
         """The expensive half (pickle/framing/compression), exposed

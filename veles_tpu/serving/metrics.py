@@ -61,8 +61,18 @@ class ServingStats(object):
     #: reflects the CURRENT load, not the whole process lifetime.
     RATE_WINDOW = 30.0
 
+    #: Batch-occupancy histogram bucket bounds (rows per executed
+    #: device batch) for the Prometheus view of ``_occupancy``.
+    ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)
+
     def __init__(self, window=512):
-        self.counters = ResilienceStats()
+        # One typed registry per engine: counters (through the PR-1
+        # shim API), latency histograms, and gauges — rendered as
+        # Prometheus text by the ModelServer's ``GET /metrics``
+        # alongside the process-wide registry.
+        from ..observability.metrics import MetricsRegistry
+        self.registry = MetricsRegistry()
+        self.counters = ResilienceStats(registry=self.registry)
         self._occupancy = {}  # rows-per-executed-batch -> count
         self._latency = {}  # kind -> LatencyWindow
         self._window = int(window)
@@ -80,6 +90,9 @@ class ServingStats(object):
         """One executed device batch: ``rows`` real rows coalesced,
         end-to-end device latency in seconds."""
         self.counters.incr("batches.%s" % kind)
+        self.registry.histogram(
+            "serving.batch_rows", labels={"kind": kind},
+            buckets=self.ROW_BUCKETS).observe(rows)
         with self._lock:
             self._occupancy[int(rows)] = \
                 self._occupancy.get(int(rows), 0) + 1
@@ -87,6 +100,10 @@ class ServingStats(object):
             if win is None:
                 win = self._latency[kind] = LatencyWindow(self._window)
         win.observe(latency_seconds)
+        self.registry.histogram(
+            "serving.latency_seconds",
+            labels={"kind": "batch.%s" % kind}).observe(
+            latency_seconds)
 
     def observe_request(self, kind, latency_seconds):
         """One completed request (queue wait + device time)."""
@@ -103,12 +120,27 @@ class ServingStats(object):
             if win is None:
                 win = self._latency[key] = LatencyWindow(self._window)
         win.observe(seconds)
+        self.registry.histogram(
+            "serving.latency_seconds",
+            labels={"kind": key}).observe(seconds)
 
     def set_gauge(self, name, value):
         """Point-in-time value (KV blocks used, active decode rows);
-        the latest write wins and rides ``snapshot()``."""
+        the latest write wins and rides ``snapshot()`` — and the
+        typed registry, so ``/metrics`` scrapes it too."""
         with self._lock:
             self._gauges[name] = value
+        try:
+            self.registry.gauge("serving.%s" % name).set(
+                float(value))
+        except (TypeError, ValueError):
+            pass
+
+    def refresh_gauges(self):
+        """Recomputes the derived gauges (sliding-window token rate)
+        right before a scrape/snapshot."""
+        self.set_gauge("decode_tok_per_sec",
+                       round(self.tokens_per_second(), 2))
 
     def note_tokens(self, n):
         """``n`` tokens generated now — feeds the sliding-window

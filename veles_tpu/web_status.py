@@ -61,6 +61,10 @@ class WebStatusServer(JsonHttpServer):
                                "text/html")
                 elif self.path == "/api/status":
                     self.reply(200, outer.status())
+                elif self.path == "/metrics":
+                    from .observability.metrics import CONTENT_TYPE
+                    self.reply(200, outer.metrics_text(),
+                               CONTENT_TYPE)
                 else:
                     self.reply(404, {"error": "not found"})
 
@@ -140,6 +144,37 @@ class WebStatusServer(JsonHttpServer):
             del self._masters[mid]
             self._commands.pop(mid, None)
 
+    #: Heartbeat sections whose numeric leaves are re-exposed as
+    #: labeled Prometheus gauges on ``GET /metrics`` — ONE scrape
+    #: endpoint covers every master this dashboard tracks.
+    METRIC_SECTIONS = ("comms", "resilience", "perf", "serving",
+                      "metrics")
+
+    def metrics_text(self):
+        """Prometheus text exposition: this process's own registry
+        plus, per tracked master, every numeric value from the
+        heartbeat's metric-bearing sections as a gauge labeled
+        ``{master="<id>"}`` (docs/observability.md)."""
+        from .observability import metrics as obs_metrics
+        samples = []
+        for mid, info in sorted(self.status().items()):
+            for section in self.METRIC_SECTIONS:
+                data = info.get(section)
+                if not isinstance(data, dict):
+                    continue
+                for key, value in sorted(data.items()):
+                    if isinstance(value, bool) or \
+                            not isinstance(value, (int, float)):
+                        continue
+                    samples.append(("%s.%s" % (section, key),
+                                    {"master": mid}, value))
+            age = info.get("age")
+            if isinstance(age, (int, float)):
+                samples.append(("master.heartbeat_age_seconds",
+                                {"master": mid}, age))
+        return obs_metrics.render_prometheus(
+            [obs_metrics.registry], extra_samples=samples)
+
     def render_page(self):
         # Heartbeat JSON is network-supplied: escape every interpolated
         # field so a hostile peer cannot store XSS into the dashboard.
@@ -179,6 +214,13 @@ class WebStatusServer(JsonHttpServer):
                 "<tr><th>serving</th><td>%s</td></tr>" %
                 esc(json.dumps(serving, sort_keys=True))
                 if isinstance(serving, dict) and serving else "")
+            # Perf row: live device-time + MFU attribution of the
+            # fused step (observability heartbeat "perf" section).
+            perf = info.get("perf")
+            perf_row = (
+                "<tr><th>perf</th><td>%s</td></tr>" %
+                esc(json.dumps(perf, sort_keys=True))
+                if isinstance(perf, dict) and perf else "")
             # Training health (guardian heartbeat section): flag a
             # master that detected NaN/spike events prominently.
             health = info.get("health")
@@ -195,14 +237,14 @@ class WebStatusServer(JsonHttpServer):
                 "<table><tr><th>mode</th><td>%s</td></tr>"
                 "<tr><th>epoch</th><td>%s</td></tr>"
                 "<tr><th>runtime</th><td>%.0f s</td></tr>"
-                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s%s"
+                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s%s%s"
                 "</table>" %
                 (esc(info.get("workflow", "?")), esc(mid),
                  esc(info.get("mode", "?")), esc(info.get("epoch", "?")),
                  runtime,
                  esc(json.dumps(info.get("metrics", {}))),
                  health_row, resilience_row, comms_row,
-                 serving_row) +
+                 serving_row, perf_row) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
                  "</th><th>jobs</th><th>jobs/s</th></tr>%s</table>"
                  % wtable if workers else "") +

@@ -404,9 +404,16 @@ class Workflow(Container):
                 fout.write(text)
         return text
 
-    def print_stats(self, top_number=5):
+    def print_stats(self, top_number=5, flat=False):
         """Logs top-N units by accumulated run time
-        (reference: workflow.py:754-812)."""
+        (reference: workflow.py:754-812).
+
+        Counters are grouped by their dotted prefix (``net``,
+        ``chaos``, ``server``, ``device``, …) with zero-valued
+        entries and empty sections suppressed, so the exit report
+        stays readable as the metric set grows; ``flat=True`` keeps
+        the historical one-line ``name=value`` format (tests that
+        grep for full dotted names use it)."""
         stats = sorted(((u.run_time, u) for u in self._units
                         if u is not self),
                        key=lambda p: p[0], reverse=True)
@@ -416,14 +423,27 @@ class Workflow(Container):
         for rt, u in stats[:top_number]:
             self.info("  %-24s %8.3fs (%4.1f%%, %d runs)",
                       u.name, rt, 100.0 * rt / total, u.run_count)
-        # Resilience events (retries, drops, blacklists, crashes,
-        # resumes) ride the same stats report so degraded runs are
-        # visible right next to the timing table.
+        # Resilience/comms/device counters ride the same stats report
+        # so degraded runs are visible right next to the timing table.
         from . import resilience
-        events = resilience.stats.snapshot()
+        events = {k: v for k, v in
+                  resilience.stats.snapshot().items() if v}
         if events:
-            self.info("Resilience events: %s", "; ".join(
-                "%s=%d" % (k, v) for k, v in sorted(events.items())))
+            if flat:
+                self.info("Resilience events: %s", "; ".join(
+                    "%s=%d" % (k, v)
+                    for k, v in sorted(events.items())))
+            else:
+                groups = {}
+                for name, value in events.items():
+                    prefix, _, rest = name.partition(".")
+                    groups.setdefault(prefix, []).append(
+                        (rest or name, value))
+                self.info("Counters:")
+                for prefix in sorted(groups):
+                    self.info("  %-10s %s", prefix + ":", "; ".join(
+                        "%s=%s" % (k, v)
+                        for k, v in sorted(groups[prefix])))
         # Training health: a recovered run must still LOOK sick in
         # the exit report, or nobody audits what the guardian ate.
         guardian = getattr(self, "guardian", None)
