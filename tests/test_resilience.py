@@ -447,8 +447,11 @@ def test_watchdog_blacklists_hung_worker_and_requeues_exactly_once():
     re-dispatched to a healthy worker EXACTLY once, and the run
     completes with a clean ledger."""
     master = LedgerWorkflow(Launcher(), total_jobs=3)
+    # Tiny parole cooldown: the healthy replacement worker shares
+    # this machine's mid, so it rejoins ON PROBATION — the run
+    # completing proves parole hands out work again.
     server = Server(":0", master, job_timeout=0.4,
-                    watchdog_interval=0.05)
+                    watchdog_interval=0.05, blacklist_cooldown=0.05)
     addr = "127.0.0.1:%d" % server.port
     hang_injector = FaultInjector("worker.hang@job:1")
     client_a, thread_a, _ = _start_client(addr, injector=hang_injector,

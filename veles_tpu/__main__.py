@@ -265,6 +265,12 @@ class Main(Logger, CommandLineBase):
             root.common.snapshotter.keep = args.snapshot_keep
         if args.no_snapshots:
             root.common.snapshot_disabled = True
+        if args.snapshot_artifact:
+            root.common.snapshotter.artifact = True
+        # Coordinator knobs (server.py reads these back).
+        if args.blacklist_cooldown is not None:
+            root.common.server.blacklist_cooldown = \
+                args.blacklist_cooldown
         # Training health guardian knobs (guardian.init_parser):
         # workflow builders read these back at construction.
         if args.guardian_policy is not None:
@@ -294,6 +300,14 @@ class Main(Logger, CommandLineBase):
                 args.serve_kv_block_size
         if args.serve_no_paged:
             root.common.serving.paged = False
+        if args.serve_drain_timeout is not None:
+            root.common.serving.drain_timeout = \
+                args.serve_drain_timeout
+        if args.serve_reload_watch is not None:
+            root.common.serving.reload_watch = \
+                args.serve_reload_watch
+        if args.serve_reload_poll is not None:
+            root.common.serving.reload_poll = args.serve_reload_poll
         # Attention fast-path knobs (ops/attention.init_parser;
         # docs/attention.md) — read back at unit construction
         # (fused_qkv freezes the parameter layout) and inside the

@@ -250,6 +250,17 @@ class Client(Logger):
         while not self._stop and time.time() < deadline:
             time.sleep(0.05)
 
+    def _say_goodbye(self, chan):
+        """Explicit end-of-session frame on a clean LOCAL stop: the
+        master deregisters this worker without the drop+requeue
+        error path (``server.drop`` stays a pure error signal — a
+        clean exit and a crash used to be indistinguishable).  Best
+        effort: a dead channel simply degrades to the drop path."""
+        try:
+            chan.send({"cmd": "bye"})
+        except Exception:
+            pass
+
     def _nojob_backoff(self):
         """Jittered exponential no-job backoff on the shared
         :class:`RetryPolicy` (base ``poll_delay``, capped at 2 s),
@@ -351,6 +362,7 @@ class Client(Logger):
             update, spans = self._traced_job(msg, trace_on)
             chan.send(self._update_msg(update, spans))
             self._maybe_remeasure_power(chan)
+        self._say_goodbye(chan)
         return True
 
     def _handshake(self, chan):
@@ -450,4 +462,5 @@ class Client(Logger):
             if ack.get("cmd") == "bye":
                 return True
             self._maybe_remeasure_power(chan)
+        self._say_goodbye(chan)
         return True

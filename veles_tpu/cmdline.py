@@ -82,6 +82,15 @@ class CommandLineBase(object):
             help="run as the distributed coordinator (master), "
                  "listening on HOST:PORT")
         parser.add_argument(
+            "--blacklist-cooldown", type=float, default=None,
+            metavar="SEC",
+            help="blacklist parole: a worker machine blacklisted by "
+                 "the adaptive job-timeout watchdog is re-admitted "
+                 "on PROBATION (one in-flight job until it completes "
+                 "clean) after this many seconds instead of being "
+                 "ejected for good (default 60; 0 = immediate "
+                 "probation)")
+        parser.add_argument(
             "-m", "--master-address", default="", metavar="HOST:PORT",
             help="run as a worker (slave) of the coordinator at "
                  "HOST:PORT")

@@ -528,6 +528,20 @@ class KVBlockPool(object):
             self.cow_copies += 1
         return ids[0]
 
+    def drop_prefixes(self):
+        """Releases every cached prompt-prefix entry (blocks return
+        to the free list once unreferenced) and returns how many were
+        dropped.  Hot weight reload calls this: cached prefixes hold
+        k/v computed under the OLD weights, and serving them to a
+        post-swap request would mix two models in one sequence.  Live
+        rows keep their tables — only the cache is invalidated."""
+        with self._lock:
+            dropped = len(self._prefix)
+            while self._prefix:
+                _, ids = self._prefix.popitem(last=False)
+                self._release_locked(ids)
+            return dropped
+
     # -- observability ---------------------------------------------------
 
     def occupancy(self):
@@ -578,7 +592,16 @@ class ExportedModel(object):
     (the Python mirror of the native runtime)."""
 
     def __init__(self, path, compile_capacity=32):
-        with tarfile.open(path, "r:gz") as tar:
+        if hasattr(path, "read"):
+            # A file object (e.g. an already-verified in-memory blob
+            # from the reload path — what was hashed is exactly what
+            # loads, no second read of a file that may have changed).
+            tar = tarfile.open(fileobj=path, mode="r:gz")
+            self.path = getattr(path, "name", None)
+        else:
+            tar = tarfile.open(path, "r:gz")
+            self.path = path
+        with tar:
             manifest_blob = tar.extractfile("manifest.json").read()
             weights_blob = tar.extractfile("weights.npz").read()
         self.manifest = json.loads(manifest_blob)
@@ -595,6 +618,12 @@ class ExportedModel(object):
         self._jit_forward = None
         self.compile_capacity = int(compile_capacity)
         self._compile_cache = None
+        #: Monotonically increasing weight generation: 1 at load,
+        #: bumped by every :meth:`swap_weights` — the serving layer
+        #: surfaces it as the ``weight_version`` gauge.
+        self.weight_version = 1
+        self._jax_weights = None
+        self._lm_params_cache = None
 
     @property
     def compile_cache(self):
@@ -630,6 +659,80 @@ class ExportedModel(object):
         except Bug:
             return None
         return int(self.weights[emb["params"]["pos"]].shape[0])
+
+    # ---- hot weight swap ----------------------------------------------
+
+    def _device_weights(self):
+        """The full weight dict as device-resident arrays — one
+        host→device transfer per weight generation, not per call.
+        Every jitted program takes its weights from here as a TRACED
+        pytree argument, so a same-geometry swap reuses the compiled
+        executables (same shapes/dtypes → same program)."""
+        if self._jax_weights is None:
+            import jax.numpy as jnp
+            self._jax_weights = {k: jnp.asarray(v)
+                                 for k, v in self.weights.items()}
+        return self._jax_weights
+
+    def _lm_params(self):
+        """The LM decode-program parameter pytree (embedding, head,
+        per-block dicts), built from :meth:`_device_weights` and
+        invalidated with it on :meth:`swap_weights`."""
+        if self._lm_params_cache is None:
+            emb, blocks, head = self._lm_chain()
+            dev = self._device_weights()
+            self._lm_params_cache = {
+                "emb_w": dev[emb["params"]["weights"]],
+                "emb_pos": dev[emb["params"]["pos"]],
+                "head_w": dev[head["params"]["weights"]],
+                "head_b": dev[head["params"]["bias"]]
+                if "bias" in head["params"] else None,
+                "blocks": [{n: dev[e["params"][n]]
+                            for n in e["params"]} for e in blocks],
+            }
+        return self._lm_params_cache
+
+    def geometry_of(self):
+        """The swap-compatibility fingerprint: the unit table plus
+        every weight's shape.  Two artifacts with equal geometry can
+        hot-swap weights through the SAME compiled programs."""
+        return (self.units,
+                {k: tuple(v.shape) for k, v in self.weights.items()})
+
+    def same_geometry(self, other):
+        """True when ``other``'s weights can be swapped into this
+        model's compiled programs in place."""
+        return self.geometry_of() == other.geometry_of()
+
+    def swap_weights(self, new_weights):
+        """In-place hot weight swap: replaces every parameter with
+        the same-named array from ``new_weights`` and bumps
+        :attr:`weight_version`.  The compile cache survives untouched
+        — weights are traced arguments, so the cached executables
+        simply read the new values on their next call.  Raises
+        :class:`Bug` on any geometry mismatch (missing/extra/reshaped
+        keys); the caller falls back to a full model replacement
+        (drain-and-swap)."""
+        new = {k: numpy.asarray(v, dtype=numpy.float32)
+               for k, v in new_weights.items()}
+        mine = {k: tuple(v.shape) for k, v in self.weights.items()}
+        theirs = {k: tuple(v.shape) for k, v in new.items()}
+        if mine != theirs:
+            missing = sorted(set(mine) - set(theirs))
+            extra = sorted(set(theirs) - set(mine))
+            reshaped = sorted(
+                "%s %s->%s" % (k, mine[k], theirs[k])
+                for k in set(mine) & set(theirs)
+                if mine[k] != theirs[k])
+            raise Bug(
+                "weight geometry mismatch — in-place swap impossible"
+                " (missing: %s; new: %s; reshaped: %s)" %
+                (missing or "-", extra or "-", reshaped or "-"))
+        self.weights = new
+        self._jax_weights = None
+        self._lm_params_cache = None
+        self.weight_version += 1
+        return self.weight_version
 
     # ---- numpy reference path (native-runtime mirror) -----------------
 
@@ -897,11 +1000,15 @@ class ExportedModel(object):
     # ---- jax serving path ---------------------------------------------
 
     def forward(self, x):
-        """Jitted jax forward (compiles once per batch shape)."""
+        """Jitted jax forward (compiles once per batch shape; the
+        weights ride as a traced pytree argument so a hot swap reuses
+        the compiled executable)."""
         import jax
         if self._jit_forward is None:
-            self._jit_forward = jax.jit(self._jax_chain)
+            self._jit_forward = jax.jit(
+                lambda weights, x: self._jax_chain(x, weights))
         return numpy.asarray(self._jit_forward(
+            self._device_weights(),
             numpy.asarray(x, dtype=numpy.float32)))
 
     def forward_bucketed(self, x, batch_bucket):
@@ -937,42 +1044,49 @@ class ExportedModel(object):
         return functools.partial(attention, causal=causal,
                                  precision="f32", kernel="xla")
 
-    def _jax_chain(self, x):
+    def _jax_chain(self, x, weights=None):
+        """The traced forward chain.  ``weights`` is the pytree the
+        jit passes as an ARGUMENT (hot-swappable); None falls back to
+        the host dict for direct/debug calls."""
         import jax
         import jax.numpy as jnp
         from jax import lax
+        if weights is None:
+            weights = self.weights
+
+        def par(entry, name):
+            return weights[entry["params"][name]]
+
         x = self._shape_input(x)
         for entry in self.units:
             t = entry["type"]
             cfg = entry["config"]
             if t == "mean_disp":
-                x = (x - self._param(entry, "mean")) * \
-                    self._param(entry, "rdisp")
+                x = (x - par(entry, "mean")) * par(entry, "rdisp")
             elif t == "dropout":
                 pass
             elif t.startswith("activation_"):
                 x = _jax_act(t.split("activation_")[1], x)
             elif t.startswith("all2all") or t in ("softmax", "rbm"):
-                w = self._param(entry, "weights")
+                w = par(entry, "weights")
                 y = x.reshape(x.shape[0], -1) @ w
                 if "bias" in entry["params"]:
-                    y = y + self._param(entry, "bias")
+                    y = y + par(entry, "bias")
                 x = _jax_act(_DENSE_ACT[t], y)
                 shape = cfg.get("output_sample_shape")
                 if shape:
                     x = x.reshape((x.shape[0],) + tuple(shape))
             elif t == "embedding":
-                w = jnp.asarray(self._param(entry, "weights"))
+                w = jnp.asarray(par(entry, "weights"))
                 # Explicit clamp: jnp indexing wraps negatives where
                 # the native runtime (and the numpy mirror) clamp.
                 tokens = jnp.clip(x.astype(jnp.int32), 0,
                                   w.shape[0] - 1)
                 x = (w[tokens] +
-                     self._param(entry, "pos")[:tokens.shape[1]])
+                     par(entry, "pos")[:tokens.shape[1]])
             elif t == "transformer_block":
                 from .znicz.attention import transformer_block_apply
-                p = {n: self._param(entry, n)
-                     for n in entry["params"]}
+                p = {n: par(entry, n) for n in entry["params"]}
                 x = transformer_block_apply(
                     p, x, int(cfg["n_heads"]),
                     bool(cfg.get("causal", 1)), jnp.float32,
@@ -981,7 +1095,7 @@ class ExportedModel(object):
             elif t == "moe_transformer_block":
                 from .znicz.attention import transformer_block_apply
                 from .ops.moe import moe_ffn
-                p = {n: jnp.asarray(self._param(entry, n))
+                p = {n: jnp.asarray(par(entry, n))
                      for n in entry["params"]}
                 cf = float(cfg.get("capacity_factor", 1.25))
 
@@ -1000,13 +1114,13 @@ class ExportedModel(object):
                         bool(cfg.get("causal", 1))),
                     mlp=moe_mlp)
             elif t == "lm_head":
-                w = self._param(entry, "weights")
+                w = par(entry, "weights")
                 y = x @ w
                 if "bias" in entry["params"]:
-                    y = y + self._param(entry, "bias")
+                    y = y + par(entry, "bias")
                 x = y
             elif t == "kohonen":
-                w = self._param(entry, "weights")
+                w = par(entry, "weights")
                 xf = x.reshape(x.shape[0], -1)
                 # Expanded ‖x−w‖² cancels catastrophically under the
                 # TPU's default bf16-input matmul — distances sit near
@@ -1016,13 +1130,13 @@ class ExportedModel(object):
                 x = ((xf * xf).sum(1, keepdims=True) - 2.0 * xw +
                      (w * w).sum(1))
             elif t.startswith("conv"):
-                w = self._param(entry, "weights")
+                w = par(entry, "weights")
                 y = lax.conv_general_dilated(
                     x, w, window_strides=tuple(cfg["sliding"]),
                     padding=tuple(tuple(p) for p in cfg["padding"]),
                     dimension_numbers=("NHWC", "HWIO", "NHWC"))
                 if "bias" in entry["params"]:
-                    y = y + self._param(entry, "bias")
+                    y = y + par(entry, "bias")
                 act = {"conv": "linear", "conv_tanh": "tanh",
                        "conv_relu": "softplus", "conv_str": "str",
                        "conv_sigmoid": "sigmoid"}[t]
@@ -1143,34 +1257,27 @@ class ExportedModel(object):
         import jax.numpy as jnp
         from jax import lax
         emb, blocks, head = self._lm_chain()
-        # jnp arrays up front: numpy tables cannot be fancy-indexed
-        # or dynamic-sliced by traced token ids/positions.
-        emb_w = jnp.asarray(self.weights[emb["params"]["weights"]])
-        emb_pos = jnp.asarray(self.weights[emb["params"]["pos"]])
-        head_w = self.weights[head["params"]["weights"]]
-        head_b = self.weights[head["params"]["bias"]] \
-            if "bias" in head["params"] else None
-        block_params = [
-            {n: self.weights[e["params"][n]] for n in e["params"]}
-            for e in blocks]
         n_heads = [int(e["config"]["n_heads"]) for e in blocks]
+        # Static geometry from the weights AT BUILD TIME; the weight
+        # VALUES arrive as a traced pytree argument per call, so a
+        # same-geometry hot swap rides this compiled program.
+        P, E = self.weights[emb["params"]["pos"]].shape
+        V = self.weights[emb["params"]["weights"]].shape[0]
         L = S0 + max_new
-        if L > emb_pos.shape[0]:
+        if L > P:
             raise Bug(
                 "prompt %d + %d new tokens exceeds the model's "
-                "positional table (%d)" %
-                (S0, max_new, emb_pos.shape[0]))
-        E = emb_w.shape[1]
+                "positional table (%d)" % (S0, max_new, P))
 
-        def embed(tokens, start):
-            t = jnp.clip(tokens.astype(jnp.int32), 0,
-                         emb_w.shape[0] - 1)
-            pos = lax.dynamic_slice(emb_pos, (start, 0),
+        def embed(params, tokens, start):
+            t = jnp.clip(tokens.astype(jnp.int32), 0, V - 1)
+            pos = lax.dynamic_slice(params["emb_pos"], (start, 0),
                                     (t.shape[1], E))
-            return emb_w[t] + pos
+            return params["emb_w"][t] + pos
 
-        def logits_of(x_last):
-            return _head_logits(x_last, head_w, head_b)
+        def logits_of(params, x_last):
+            return _head_logits(x_last, params["head_w"],
+                                params["head_b"])
 
         def sample(logits, key, temperature):
             """Greedy/temperature select with temperature as a TRACED
@@ -1183,29 +1290,30 @@ class ExportedModel(object):
                 axis=-1).astype(jnp.int32)
             return jnp.where(temperature > 0.0, sampled, greedy)
 
-        def run(prompt, key, temperature):
+        def run(params, prompt, key, temperature):
             B = prompt.shape[0]
-            x = embed(prompt, 0)
+            block_params = params["blocks"]
+            x = embed(params, prompt, 0)
             caches = []
             for p, H in zip(block_params, n_heads):
                 ck = jnp.zeros((B, L, H, E // H), jnp.float32)
                 cv = jnp.zeros((B, L, H, E // H), jnp.float32)
                 x, ck, cv = self._cached_block(p, x, ck, cv, 0, H)
                 caches.append((ck, cv))
-            first_logits = logits_of(x[:, -1])
+            first_logits = logits_of(params, x[:, -1])
             tok0 = sample(first_logits, jax.random.fold_in(key, 0),
                           temperature)
 
             def body(carry, j):
                 prev_tok, caches = carry
                 t = S0 + j  # position the previous token occupies
-                x = embed(prev_tok[:, None], t)
+                x = embed(params, prev_tok[:, None], t)
                 new_caches = []
                 for (ck, cv), p, H in zip(caches, block_params,
                                           n_heads):
                     x, ck, cv = self._cached_block(p, x, ck, cv, t, H)
                     new_caches.append((ck, cv))
-                logits = logits_of(x[:, 0])
+                logits = logits_of(params, x[:, 0])
                 tok = sample(logits, jax.random.fold_in(key, j + 1),
                              temperature)
                 return (tok, new_caches), (prev_tok, logits)
@@ -1290,7 +1398,8 @@ class ExportedModel(object):
         fn = self.compile_cache.get_or_build(
             ("gen", S0, max_new),
             lambda: self._build_generate(S0, max_new))
-        tokens, logits = fn(prompt, jax.random.PRNGKey(seed),
+        tokens, logits = fn(self._lm_params(), prompt,
+                            jax.random.PRNGKey(seed),
                             jnp.float32(temperature))
         tokens = numpy.asarray(tokens)
         full = numpy.concatenate([prompt, tokens], axis=1)
@@ -1324,30 +1433,25 @@ class ExportedModel(object):
         import jax.numpy as jnp
         from jax import lax
         emb, blocks, head = self._lm_chain()
-        emb_w = jnp.asarray(self.weights[emb["params"]["weights"]])
-        emb_pos = jnp.asarray(self.weights[emb["params"]["pos"]])
-        head_w = self.weights[head["params"]["weights"]]
-        head_b = self.weights[head["params"]["bias"]] \
-            if "bias" in head["params"] else None
-        block_params = [
-            {n: self.weights[e["params"][n]] for n in e["params"]}
-            for e in blocks]
         n_heads = [int(e["config"]["n_heads"]) for e in blocks]
-        P = emb_pos.shape[0]
+        P, E = self.weights[emb["params"]["pos"]].shape
+        V = self.weights[emb["params"]["weights"]].shape[0]
         if S0b > P:
             raise Bug("prompt bucket %d exceeds the model's "
                       "positional table (%d)" % (S0b, P))
-        E = emb_w.shape[1]
         L = S0b + max_new
-        V = emb_w.shape[0]
 
-        def logits_of(x_last):
-            return _head_logits(x_last, head_w, head_b)
+        def logits_of(params, x_last):
+            return _head_logits(x_last, params["head_w"],
+                                params["head_b"])
 
         sample_rows = _sample_rows
 
-        def run(prompts, lengths, seeds, temps):
+        def run(params, prompts, lengths, seeds, temps):
             B = prompts.shape[0]
+            emb_w = params["emb_w"]
+            emb_pos = params["emb_pos"]
+            block_params = params["blocks"]
             keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
             t = jnp.clip(prompts.astype(jnp.int32), 0, V - 1)
             x = emb_w[t] + emb_pos[:S0b]
@@ -1358,7 +1462,7 @@ class ExportedModel(object):
                 x, ck, cv = self._cached_block(p, x, ck, cv, 0, H)
                 caches.append((ck, cv))
             idx = jnp.clip(lengths - 1, 0, S0b - 1)
-            first_logits = logits_of(x[jnp.arange(B), idx])
+            first_logits = logits_of(params, x[jnp.arange(B), idx])
             tok0 = sample_rows(
                 first_logits,
                 jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys0),
@@ -1385,7 +1489,7 @@ class ExportedModel(object):
                     xj, ck, cv = self._cached_block(
                         p, xj, ck, cv, slot, H, key_mask=kmask)
                     new_caches.append((ck, cv))
-                logits = logits_of(xj[:, 0])
+                logits = logits_of(params, xj[:, 0])
                 tok = sample_rows(
                     logits,
                     jax.vmap(lambda k: jax.random.fold_in(k, j + 1))(
@@ -1448,7 +1552,8 @@ class ExportedModel(object):
         fn = self.compile_cache.get_or_build(
             ("genb", B, S0b, max_new),
             lambda: self._build_generate_bucketed(S0b, max_new))
-        return numpy.asarray(fn(prompts, lengths, seeds, temps))
+        return numpy.asarray(fn(self._lm_params(), prompts, lengths,
+                                seeds, temps))
 
     # ---- paged serving decode (block-pool KV cache) -------------------
 
@@ -1547,21 +1652,15 @@ class ExportedModel(object):
             + p["b2"]
         return x.astype(jnp.float32), pk, pv
 
-    def _paged_lm_tables(self):
-        """Shared (embed/head/block param) pieces of the paged
-        programs, as jnp-ready arrays."""
-        import jax.numpy as jnp
-        emb, blocks, head = self._lm_chain()
-        emb_w = jnp.asarray(self.weights[emb["params"]["weights"]])
-        emb_pos = jnp.asarray(self.weights[emb["params"]["pos"]])
-        head_w = self.weights[head["params"]["weights"]]
-        head_b = self.weights[head["params"]["bias"]] \
-            if "bias" in head["params"] else None
-        block_params = [
-            {n: self.weights[e["params"][n]] for n in e["params"]}
-            for e in blocks]
+    def _paged_lm_static(self):
+        """Static geometry of the paged programs: (n_heads per block,
+        positional-table size, vocab size).  The weight VALUES arrive
+        per call through :meth:`_lm_params`."""
+        emb, blocks, _head = self._lm_chain()
         n_heads = [int(e["config"]["n_heads"]) for e in blocks]
-        return emb_w, emb_pos, head_w, head_b, block_params, n_heads
+        P = int(self.weights[emb["params"]["pos"]].shape[0])
+        V = int(self.weights[emb["params"]["weights"]].shape[0])
+        return n_heads, P, V
 
     def _build_paged_extend(self, Sc, T, block_size):
         """Jitted chunk prefill/extension against the block pool:
@@ -1577,20 +1676,18 @@ class ExportedModel(object):
         path's stream)."""
         import jax
         import jax.numpy as jnp
-        emb_w, emb_pos, head_w, head_b, block_params, n_heads = \
-            self._paged_lm_tables()
-        P = emb_pos.shape[0]
-        V = emb_w.shape[0]
+        n_heads, P, V = self._paged_lm_static()
         bs = int(block_size)
         S_keys = T * bs
 
-        def logits_of(x_last):
-            return _head_logits(x_last, head_w, head_b)
+        def logits_of(params, x_last):
+            return _head_logits(x_last, params["head_w"],
+                                params["head_b"])
 
         sample_rows = _sample_rows
 
-        def run(pks, pvs, tables, tokens, prior, chunk_len, temps,
-                seeds):
+        def run(params, pks, pvs, tables, tokens, prior, chunk_len,
+                temps, seeds):
             B = tables.shape[0]
             keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
             offs = jnp.arange(Sc)
@@ -1598,7 +1695,8 @@ class ExportedModel(object):
             # read junk that is never unmasked).
             posn = jnp.clip(prior[:, None] + offs[None, :], 0, P - 1)
             t = jnp.clip(tokens.astype(jnp.int32), 0, V - 1)
-            x = emb_w[t] + jnp.take(emb_pos, posn, axis=0)
+            x = params["emb_w"][t] + \
+                jnp.take(params["emb_pos"], posn, axis=0)
             wpos = jnp.clip(prior[:, None] + offs[None, :], 0,
                             S_keys - 1)
             wblock = jnp.take_along_axis(tables, wpos // bs, axis=1)
@@ -1607,20 +1705,21 @@ class ExportedModel(object):
             key_mask = (jnp.arange(S_keys)[None, None, :] <=
                         qpos[:, :, None])
             new_pks, new_pvs = [], []
-            for pk, pv, p, H in zip(pks, pvs, block_params, n_heads):
+            for pk, pv, p, H in zip(pks, pvs, params["blocks"],
+                                    n_heads):
                 x, pk, pv = self._paged_block(
                     p, x, pk, pv, tables, wblock, wslot, key_mask, H)
                 new_pks.append(pk)
                 new_pvs.append(pv)
             idx = jnp.clip(chunk_len - 1, 0, Sc - 1)
-            first_logits = logits_of(x[jnp.arange(B), idx])
+            first_logits = logits_of(params, x[jnp.arange(B), idx])
             tok0 = sample_rows(
                 first_logits,
                 jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys0),
                 temps)
             return new_pks, new_pvs, tok0
 
-        return jax.jit(run, donate_argnums=(0, 1))
+        return jax.jit(run, donate_argnums=(1, 2))
 
     def _build_paged_step(self, T, block_size):
         """Jitted one-token decode step over the block pool: each
@@ -1633,23 +1732,22 @@ class ExportedModel(object):
         rows carry all-trash tables and scatter junk into block 0."""
         import jax
         import jax.numpy as jnp
-        emb_w, emb_pos, head_w, head_b, block_params, n_heads = \
-            self._paged_lm_tables()
-        P = emb_pos.shape[0]
-        V = emb_w.shape[0]
+        n_heads, P, V = self._paged_lm_static()
         bs = int(block_size)
         S_keys = T * bs
 
-        def logits_of(x_last):
-            return _head_logits(x_last, head_w, head_b)
+        def logits_of(params, x_last):
+            return _head_logits(x_last, params["head_w"],
+                                params["head_b"])
 
         sample_rows = _sample_rows
 
-        def run(pks, pvs, tables, pos, tok, gen_idx, temps, seeds):
+        def run(params, pks, pvs, tables, pos, tok, gen_idx, temps,
+                seeds):
             keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
             posn = jnp.clip(pos, 0, P - 1)
-            x = emb_w[jnp.clip(tok, 0, V - 1)][:, None] + \
-                jnp.take(emb_pos, posn, axis=0)[:, None]
+            x = params["emb_w"][jnp.clip(tok, 0, V - 1)][:, None] + \
+                jnp.take(params["emb_pos"], posn, axis=0)[:, None]
             wpos = jnp.clip(pos, 0, S_keys - 1)
             wblock = jnp.take_along_axis(
                 tables, (wpos // bs)[:, None], axis=1)
@@ -1657,18 +1755,19 @@ class ExportedModel(object):
             key_mask = (jnp.arange(S_keys)[None, None, :] <=
                         pos[:, None, None])
             new_pks, new_pvs = [], []
-            for pk, pv, p, H in zip(pks, pvs, block_params, n_heads):
+            for pk, pv, p, H in zip(pks, pvs, params["blocks"],
+                                    n_heads):
                 x, pk, pv = self._paged_block(
                     p, x, pk, pv, tables, wblock, wslot, key_mask, H)
                 new_pks.append(pk)
                 new_pvs.append(pv)
-            logits = logits_of(x[:, 0])
+            logits = logits_of(params, x[:, 0])
             tok_new = sample_rows(
                 logits, jax.vmap(jax.random.fold_in)(keys0, gen_idx),
                 temps)
             return new_pks, new_pvs, tok_new
 
-        return jax.jit(run, donate_argnums=(0, 1))
+        return jax.jit(run, donate_argnums=(1, 2))
 
     def paged_extend(self, pool, tables, tokens, prior, chunk_lens,
                      temps, seeds):
@@ -1690,7 +1789,7 @@ class ExportedModel(object):
             lambda: self._build_paged_extend(Sc, T, pool.block_size))
         ks, vs = pool.storage
         ks, vs, tok0 = fn(
-            ks, vs, tables, tokens,
+            self._lm_params(), ks, vs, tables, tokens,
             numpy.ascontiguousarray(prior, dtype=numpy.int32),
             numpy.ascontiguousarray(chunk_lens, dtype=numpy.int32),
             numpy.ascontiguousarray(temps, dtype=numpy.float32),
@@ -1710,7 +1809,7 @@ class ExportedModel(object):
             lambda: self._build_paged_step(T, pool.block_size))
         ks, vs = pool.storage
         ks, vs, tok_new = fn(
-            ks, vs, tables,
+            self._lm_params(), ks, vs, tables,
             numpy.ascontiguousarray(pos, dtype=numpy.int32),
             numpy.ascontiguousarray(tok, dtype=numpy.int32),
             numpy.ascontiguousarray(gen_idx, dtype=numpy.int32),

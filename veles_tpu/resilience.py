@@ -136,6 +136,21 @@ class InjectedSnapshotCorruption(InjectedFault):
     computed, so checksum verification must reject it on resume."""
 
 
+class InjectedDeviceFault(InjectedFault):
+    """A device failure during a serving decode call (XLA abort,
+    preemption, tunnel reset): the serving engine's supervisor
+    catches it on the device thread, rebuilds the KV pool, and
+    re-adopts surviving streams from their request-side token
+    prefixes — the exact recovery path a real device fault drives."""
+
+
+class InjectedReloadCorruption(InjectedFault):
+    """Bit-rot on a serving artifact about to be hot-deployed: the
+    reload verifier catches this and flips one byte of the blob it
+    just read, so the sha256 manifest gate must reject the artifact
+    and the old weights must keep serving."""
+
+
 # -- stats -----------------------------------------------------------------
 
 class ResilienceStats(object):
@@ -321,6 +336,9 @@ FAULTS = {
     "snapshot.corrupt": ("snapshot.corrupt", InjectedSnapshotCorruption),
     "step.nan": ("step.nan", InjectedStepNaN),
     "master.crash": ("master.crash", MasterCrash),
+    "serve.device_fault": ("serve.device_fault", InjectedDeviceFault),
+    "serve.reload_corrupt": ("serve.reload_corrupt",
+                             InjectedReloadCorruption),
 }
 
 #: The valid injection-point names (for validation/docs).

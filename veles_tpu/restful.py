@@ -42,6 +42,7 @@ from .export import ExportedModel, export_workflow
 from .http_common import JsonHttpServer, JsonRequestHandler
 from .resilience import Deadline
 from .serving import AdmissionError, RateLimiter, ServingEngine
+from .serving.reload import ArtifactRejected
 from .units import Unit
 
 
@@ -85,6 +86,24 @@ def init_parser(parser):
         "--serve-no-paged", action="store_true",
         help="serving: disable paged decode-step batching and fall "
              "back to whole-request generate batching")
+    parser.add_argument(
+        "--serve-drain-timeout", type=float, default=None,
+        metavar="SEC",
+        help="serving: graceful-stop budget — on SIGTERM/stop "
+             "admissions close with 503 + Retry-After and live "
+             "decode rows get this long to finish (default 30)")
+    parser.add_argument(
+        "--serve-reload-watch", default=None, metavar="PATH",
+        help="serving: hot-reload watch target — a serving artifact "
+             "or a snapshotter *_current.lnk pointer (with "
+             "--snapshot-artifact the trainer exports a verified "
+             "artifact next to every snapshot); when it changes, the "
+             "manifest-verified artifact is hot-swapped in without "
+             "dropping live streams")
+    parser.add_argument(
+        "--serve-reload-poll", type=float, default=None,
+        metavar="SEC",
+        help="serving: reload-watch poll interval (default 5)")
 
 
 def serving_config_defaults():
@@ -93,7 +112,8 @@ def serving_config_defaults():
     out = {}
     for key in ("max_batch", "queue_depth", "rate_limit", "deadline",
                 "token", "warmup", "kv_blocks", "kv_block_size",
-                "paged"):
+                "paged", "drain_timeout", "reload_watch",
+                "reload_poll"):
         value = root.common.serving.get(key)
         if value is not None:
             out[key] = value
@@ -130,18 +150,23 @@ class ModelServer(JsonHttpServer):
     def __init__(self, model, host="0.0.0.0", port=8180, token=None,
                  max_batch=8, queue_depth=64, rate_limit=None,
                  deadline=30.0, warmup=False, policy=None,
-                 paged=None, kv_blocks=None, kv_block_size=16):
+                 paged=None, kv_blocks=None, kv_block_size=16,
+                 drain_timeout=30.0, reload_watch=None,
+                 reload_poll=5.0):
         if isinstance(model, str):
             model = ExportedModel(model)
-        self.model = model
         self.token = token
         self.deadline = deadline
         self.warmup = warmup
         self.engine = ServingEngine(
             model, max_batch=max_batch, queue_depth=queue_depth,
             policy=policy, default_deadline=deadline, paged=paged,
-            kv_blocks=kv_blocks, kv_block_size=kv_block_size)
+            kv_blocks=kv_blocks, kv_block_size=kv_block_size,
+            drain_timeout=drain_timeout)
         self.limiter = RateLimiter(rate_limit) if rate_limit else None
+        self.reload_watch = reload_watch
+        self.reload_poll = reload_poll
+        self.watcher = None
 
         class Handler(JsonRequestHandler):
             def do_GET(self):
@@ -196,6 +221,9 @@ class ModelServer(JsonHttpServer):
                 outer = self.outer
                 if self.path == "/api/generate":
                     self._generate()
+                    return
+                if self.path == "/admin/reload":
+                    self._admin_reload()
                     return
                 if self.path != "/api":
                     self.reply(404, {"error": "not found"})
@@ -303,15 +331,96 @@ class ModelServer(JsonHttpServer):
                     "generated": full[:, tokens.shape[1]:],
                 })
 
+            def _admin_reload(self):
+                """POST /admin/reload — hot weight reload of a named
+                (or the watched) artifact.  AUTHENTICATED: the server
+                must hold a token and the X-Status-Token header must
+                match — an open endpoint that loads
+                operator-supplied paths would be an arbitrary-file
+                primitive, so tokenless servers refuse outright."""
+                outer = self.outer
+                try:
+                    payload = self.read_json()
+                except Exception as e:
+                    self.reply(400, {"error": str(e)})
+                    return
+                if outer.token is None:
+                    self.reply(403, {"error": "reload requires the "
+                                              "server to hold a "
+                                              "--token"})
+                    return
+                if not self.check_token(outer.token):
+                    self.reply(403, {"error": "bad token"})
+                    return
+                path = payload.get("artifact")
+                try:
+                    version = outer.reload_artifact(path)
+                except ArtifactRejected as e:
+                    self.reply(409, {"error": str(e)})
+                    return
+                except AdmissionError as e:
+                    self.reply(e.status, {"error": str(e)},
+                               headers=_retry_headers(e))
+                    return
+                except Exception as e:
+                    outer.exception("/admin/reload failed")
+                    self.reply(500, {"error": str(e)})
+                    return
+                self.reply(200, {"status": "reloaded",
+                                 "weight_version": version})
+
         super(ModelServer, self).__init__(
             Handler, host=host, port=port,
             thread_name="veles-model-server")
+
+    @property
+    def model(self):
+        """The CURRENTLY served model — owned by the engine, so a
+        drain-and-swap reload is visible to /health and /stats the
+        moment it lands."""
+        return self.engine.model
+
+    def reload_artifact(self, path=None, require_manifest=None):
+        """Verify-and-reload: ``path`` (default: whatever the watch
+        target currently names) is read once, gated through its
+        sha256 sidecar manifest (and the ``serve.reload_corrupt``
+        chaos point), and hot-swapped into the engine.  Manifests are
+        REQUIRED for watcher-driven reloads (unattended deployment
+        trusts nothing unverified) and optional for explicit
+        operator paths.  Returns the new weight version; raises
+        :class:`~veles_tpu.serving.reload.ArtifactRejected` and
+        keeps the old weights on any verification failure."""
+        from .serving.reload import read_verified, resolve_artifact
+        explicit = path is not None
+        if path is None:
+            if self.reload_watch is None:
+                raise ArtifactRejected(
+                    "no artifact named and no --reload-watch target "
+                    "configured")
+            path = resolve_artifact(self.reload_watch)
+            if path is None:
+                raise ArtifactRejected(
+                    "watch target %s names no serving artifact yet"
+                    % self.reload_watch)
+        if require_manifest is None:
+            require_manifest = not explicit
+        blob = read_verified(path, injector=self.engine.injector,
+                             require_manifest=require_manifest)
+        version = self.engine.reload(blob)
+        self.engine.stats.incr("reload.artifacts")
+        self.info("hot-reloaded %s -> weight version %d", path,
+                  version)
+        return version
+
+    def _on_watch_change(self, path):
+        self.reload_artifact(path, require_manifest=True)
 
     def stats_payload(self):
         """The /stats body: engine + compile-cache observability."""
         payload = self.engine.stats.snapshot()
         payload["queue_depth"] = self.engine.queue_depth_now()
         payload["max_batch"] = self.engine.max_batch
+        payload["weight_version"] = self.engine.weight_version
         cache = getattr(self.model, "compile_cache", None)
         if cache is not None:
             payload["compile_cache"] = cache.stats()
@@ -346,6 +455,11 @@ class ModelServer(JsonHttpServer):
         self.engine.start()
         if self.warmup:
             self.engine.warmup()
+        if self.reload_watch is not None and self.watcher is None:
+            from .serving.reload import ArtifactWatcher
+            self.watcher = ArtifactWatcher(
+                self.reload_watch, self._on_watch_change,
+                poll=self.reload_poll).start()
 
     def start(self):
         self._spin_up()
@@ -356,9 +470,21 @@ class ModelServer(JsonHttpServer):
         self.info("serving model on port %d (POST /api)", self.port)
         super(ModelServer, self).serve()
 
-    def stop(self):
-        super(ModelServer, self).stop()
-        self.engine.stop()
+    def stop(self, drain=False, timeout=None):
+        """``drain=True`` is the graceful path: the engine closes
+        admissions (503 + Retry-After), live decode rows finish
+        within the drain budget, THEN the listener goes down — so
+        every in-flight HTTP response is delivered and late arrivals
+        get an honest 503 instead of a connection reset."""
+        if self.watcher is not None:
+            self.watcher.stop()
+            self.watcher = None
+        if drain:
+            self.engine.stop(drain=True, timeout=timeout)
+            super(ModelServer, self).stop()
+        else:
+            super(ModelServer, self).stop()
+            self.engine.stop()
 
 
 def _retry_headers(e):
@@ -377,7 +503,9 @@ class RESTfulAPI(Unit):
     ``--serve-queue-depth`` / ``--serve-rate-limit`` /
     ``--serve-deadline`` / ``--serve-token`` / ``--serve-warmup`` /
     ``--serve-kv-blocks`` / ``--serve-kv-block-size`` /
-    ``--serve-no-paged`` CLI flags or the matching kwargs below."""
+    ``--serve-no-paged`` / ``--serve-drain-timeout`` /
+    ``--serve-reload-watch`` / ``--serve-reload-poll`` CLI flags or
+    the matching kwargs below."""
 
     def __init__(self, workflow, **kwargs):
         super(RESTfulAPI, self).__init__(workflow, **kwargs)
@@ -397,6 +525,9 @@ class RESTfulAPI(Unit):
         self.paged = kwargs.get("paged", None)
         self.kv_blocks = kwargs.get("kv_blocks", None)
         self.kv_block_size = kwargs.get("kv_block_size", 16)
+        self.drain_timeout = kwargs.get("drain_timeout", 30.0)
+        self.reload_watch = kwargs.get("reload_watch", None)
+        self.reload_poll = kwargs.get("reload_poll", 5.0)
         self.server = None
 
     def run(self):
@@ -409,7 +540,10 @@ class RESTfulAPI(Unit):
             queue_depth=self.queue_depth, rate_limit=self.rate_limit,
             deadline=self.deadline, warmup=self.warmup,
             paged=self.paged, kv_blocks=self.kv_blocks,
-            kv_block_size=self.kv_block_size)
+            kv_block_size=self.kv_block_size,
+            drain_timeout=self.drain_timeout,
+            reload_watch=self.reload_watch,
+            reload_poll=self.reload_poll)
         self.port = self.server.port
         if self.blocking:
             self.server.serve()

@@ -4,11 +4,15 @@ under velescli with the RESTfulAPI unit, restful_api.py:78), through
 the production serving engine: shape-bucketed dynamic batching,
 paged KV-cache decode-step continuous batching for LM artifacts
 (``--kv-blocks`` / ``--kv-block-size`` / ``--no-paged-decode``),
-``--warmup`` grid precompilation, per-client rate limiting, and
-queue-depth backpressure (docs/serving.md)."""
+``--warmup`` grid precompilation, per-client rate limiting,
+queue-depth backpressure, hot weight reload (``--reload-watch`` /
+authenticated ``POST /admin/reload``) and graceful SIGTERM drain
+(``--drain-timeout``) — docs/serving.md."""
 
 import argparse
+import signal
 import sys
+import threading
 
 from .restful import ModelServer
 
@@ -57,6 +61,21 @@ def main(argv=None):
         "--no-paged-decode", action="store_true",
         help="disable paged decode-step continuous batching and "
              "fall back to whole-request generate batching")
+    parser.add_argument(
+        "--drain-timeout", type=float, default=30.0, metavar="SEC",
+        help="graceful-stop budget: on SIGTERM admissions close "
+             "with 503 + Retry-After and live decode rows get this "
+             "long to finish before the process exits 0 (default "
+             "30)")
+    parser.add_argument(
+        "--reload-watch", default=None, metavar="PATH",
+        help="hot-reload watch target: a serving artifact or a "
+             "snapshotter *_current.lnk pointer — when it changes, "
+             "the sha256-manifest-verified artifact is hot-swapped "
+             "in without dropping live streams")
+    parser.add_argument(
+        "--reload-poll", type=float, default=5.0, metavar="SEC",
+        help="reload-watch poll interval (default 5)")
     args = parser.parse_args(argv)
     server = ModelServer(
         args.artifact, host=args.host, port=args.port,
@@ -64,12 +83,35 @@ def main(argv=None):
         queue_depth=args.queue_depth, rate_limit=args.rate_limit,
         deadline=args.deadline, warmup=args.warmup,
         paged=False if args.no_paged_decode else None,
-        kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size)
+        kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
+        drain_timeout=args.drain_timeout,
+        reload_watch=args.reload_watch,
+        reload_poll=args.reload_poll)
+    install_sigterm_drain(server)
     try:
         server.serve()
     except KeyboardInterrupt:
-        server.stop()
+        server.stop(drain=True)
     return 0
+
+
+def install_sigterm_drain(server):
+    """SIGTERM → graceful drain → exit 0 (the supervisor-facing
+    shutdown contract: in-flight requests finish, late arrivals get
+    503 + Retry-After, and a clean exit code says this was an
+    orderly stop, not a crash).  The drain runs on a helper thread —
+    signal handlers must return quickly, and ``server.stop`` joins
+    the device thread.  No-op outside the main thread (tests import
+    and drive ``main`` directly)."""
+    def on_term(_signum, _frame):
+        threading.Thread(target=lambda: server.stop(drain=True),
+                         daemon=True,
+                         name="veles-sigterm-drain").start()
+
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except ValueError:
+        pass  # not the main thread
 
 
 if __name__ == "__main__":

@@ -124,6 +124,10 @@ class SlaveDescription(object):
         self.last_update = None
         self.blacklisted = False
         self.paused = False
+        #: Parole: this session belongs to a previously-blacklisted
+        #: machine — it gets ONE job at a time until one completes
+        #: clean (then the machine's blacklist entry is erased).
+        self.probation = False
 
     @property
     def jobs_per_second(self):
@@ -212,6 +216,16 @@ class Server(Logger):
         #: uniform job times σ≈0 and a bare mean+3σ would blacklist a
         #: healthy worker on any transient stall.
         self.job_timeout = float(kwargs.get("job_timeout", 120.0))
+        #: Blacklist parole (``--blacklist-cooldown``): machines the
+        #: watchdog blacklisted are re-admitted on probation after
+        #: this many seconds — a straggler that recovered (GC pause,
+        #: thermal throttle, network blip) rejoins the fleet instead
+        #: of being ejected for good.
+        self.blacklist_cooldown = float(kwargs.get(
+            "blacklist_cooldown",
+            config_get(root.common.server.blacklist_cooldown, 60.0)))
+        #: machine id -> wall time of its latest blacklisting.
+        self._blacklist = {}
         self._watchdog_thread = threading.Thread(
             target=self._watchdog_loop, daemon=True,
             name="veles-server-watchdog")
@@ -328,9 +342,12 @@ class Server(Logger):
                     if self._blacklist_check(desc):
                         self.warning(
                             "worker %s exceeded adaptive job timeout "
-                            "— blacklisted, requeueing its work",
-                            desc.id)
+                            "— blacklisted, requeueing its work "
+                            "(parole in %.0f s)",
+                            desc.id, self.blacklist_cooldown)
                         resilience.stats.incr("server.blacklist")
+                        if desc.mid:
+                            self._blacklist[desc.mid] = time.time()
                         if self._outstanding.pop(desc.id, None):
                             resilience.stats.incr("server.requeue")
                         self.workflow.drop_slave(desc.id)
@@ -365,6 +382,7 @@ class Server(Logger):
 
     def _serve_slave(self, conn, addr):
         desc = None
+        clean = False
         chan = Channel(conn, self._secret, injector=self.injector)
         with self._chan_lock:
             self._channels.add(chan)
@@ -397,6 +415,12 @@ class Server(Logger):
                 desc = SlaveDescription(
                     sid, hello.get("mid"), hello.get("power", 1.0),
                     addr)
+                if desc.mid in self._blacklist:
+                    # Parole: the machine was blacklisted — it may
+                    # rejoin, but on probation (no jobs until the
+                    # cooldown elapses, then one at a time until one
+                    # completes clean).
+                    desc.probation = True
                 self._slaves[sid] = desc
                 note = getattr(self.workflow, "note_slave_protocol",
                                None)
@@ -422,7 +446,10 @@ class Server(Logger):
                           proto.get("delta"), proto.get("codec"),
                           proto.get("ticks")) if proto else
                       ", pickle-compat")
-            self._message_loop(chan, desc)
+            if desc.probation:
+                self.info("worker %s joined on PROBATION (machine "
+                          "%s was blacklisted)", sid, desc.mid)
+            clean = bool(self._message_loop(chan, desc))
         except MasterCrash:
             self.crash()
         except (ConnectionError, TimeoutError):
@@ -453,9 +480,15 @@ class Server(Logger):
             # A crashed master does NOT requeue or respawn — it is
             # dead; cleanup is the restarted master's job.
             if desc is not None and not self._crashed:
-                self._drop(desc)
+                self._drop(desc, clean=clean)
 
     def _message_loop(self, chan, desc):
+        """Returns True on an ORDERLY end of session (the worker's
+        explicit goodbye, or this master's own "bye" after training
+        completed) — the caller then retires the worker without the
+        drop+requeue error path; False/None means the peer vanished
+        (crash, timeout, blacklist disconnect) and ``server.drop``
+        stays a pure error signal."""
         from .observability import tracing
         # Trace dialect for this session (handshake-negotiated):
         # replies carry clock-sync timestamps, jobs carry trace
@@ -470,7 +503,7 @@ class Server(Logger):
                 for sp in open_dispatches:
                     sp.set(dropped=True)
                     sp.finish()
-                return
+                return False
             recv_wall = time.time()
             cmd = msg.get("cmd")
             if cmd == "job_request":
@@ -483,8 +516,8 @@ class Server(Logger):
                     # recv()→None makes the client reconnect with a
                     # fresh id and a clean slate (the reference dropped
                     # the connection outright, server.py:630-635).
-                    return
-                if desc.paused:
+                    return False
+                if desc.paused or self._probation_hold(desc):
                     chan.send(self._stamp({"cmd": "no_job",
                                            "retry": True}, trace_on,
                                           recv_wall))
@@ -506,7 +539,7 @@ class Server(Logger):
                         sp.cancel()
                     if self._maybe_finished():
                         chan.send({"cmd": "bye"})
-                        return
+                        return True
                     chan.send(self._stamp({"cmd": "no_job",
                                            "retry": True}, trace_on,
                                           recv_wall))
@@ -549,13 +582,30 @@ class Server(Logger):
                                       trace_on, recv_wall))
                 if self._maybe_finished():
                     chan.send({"cmd": "bye"})
-                    return
+                    return True
             elif cmd == "power":
                 # Periodic re-measurement from the worker (reference:
                 # server.py:531) keeps load balancing honest.
                 desc.power = float(msg.get("power", desc.power))
             elif cmd == "bye":
-                return
+                # The worker's explicit end-of-session frame: a clean
+                # exit, NOT a crash — the two must be distinguishable
+                # (the satellite the reference's _drop conflated).
+                return True
+        return False
+
+    def _probation_hold(self, desc):
+        """True when a paroled worker must keep polling no_job: its
+        machine's blacklist cooldown has not elapsed yet, or its one
+        probation job is still in flight (probation = ONE job at a
+        time until one completes clean)."""
+        if not desc.probation:
+            return False
+        listed = self._blacklist.get(desc.mid)
+        if listed is not None and \
+                time.time() - listed < self.blacklist_cooldown:
+            return True
+        return bool(self._outstanding.get(desc.id))
 
     # -- workflow bridging -------------------------------------------------
 
@@ -635,6 +685,14 @@ class Server(Logger):
             desc.state = "WAIT"
             desc.jobs_done += 1
             desc.last_update = time.time()
+            if desc.probation:
+                # The probation job completed clean: parole granted —
+                # the machine rejoins the fleet at full rate.
+                desc.probation = False
+                self._blacklist.pop(desc.mid, None)
+                resilience.stats.incr("server.parole")
+                self.info("worker %s completed its probation job — "
+                          "parole granted", desc.id)
             if desc.job_started is not None:
                 desc.job_times.append(time.time() - desc.job_started)
                 desc.job_started = None
@@ -659,10 +717,14 @@ class Server(Logger):
             self.stop()
         return done
 
-    def _drop(self, desc):
-        """Connection lost → requeue in-flight work
-        (reference: server.py:315-338), then optionally respawn the
-        worker."""
+    def _drop(self, desc, clean=False):
+        """End of a worker session.  ``clean=True`` (an explicit
+        goodbye frame, or this master's own bye) DEREGISTERS the
+        worker — no requeue, no respawn, and ``server.drop`` stays a
+        pure error signal (previously a clean exit and a crash were
+        indistinguishable here).  Otherwise: connection lost →
+        requeue in-flight work (reference: server.py:315-338), then
+        optionally respawn the worker."""
         with self._lock:
             if self._slaves.pop(desc.id, None) is not None:
                 self._retired_slaves[desc.id] = desc
@@ -670,8 +732,15 @@ class Server(Logger):
                     self._retired_slaves.pop(
                         next(iter(self._retired_slaves)))
             if self._outstanding.pop(desc.id, None):
+                # A "goodbye" with work still in flight is NOT clean
+                # — the job must be requeued like any other loss.
                 resilience.stats.incr("server.requeue")
+                clean = False
             self.workflow.drop_slave(desc.id)
+        if clean:
+            resilience.stats.incr("server.goodbye")
+            self.info("worker %s retired (clean goodbye)", desc.id)
+            return
         resilience.stats.incr("server.drop")
         self.info("worker %s dropped", desc.id)
         self._maybe_respawn(desc)

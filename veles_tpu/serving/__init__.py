@@ -32,7 +32,10 @@ build on this layer; see docs/serving.md.
 
 from .admission import (AdmissionError, DeadlineExceeded,  # noqa: F401
                         EngineStopped, PoolExhausted, QueueFull,
-                        RateLimited, RateLimiter, TokenBucket)
+                        RateLimited, RateLimiter, ServiceUnavailable,
+                        TokenBucket)
 from .buckets import BucketPolicy, CompileCache, next_pow2  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .metrics import ServingStats  # noqa: F401
+from .reload import (ArtifactRejected, ArtifactWatcher,  # noqa: F401
+                     read_verified, resolve_artifact)

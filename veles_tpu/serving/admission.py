@@ -55,12 +55,22 @@ class DeadlineExceeded(AdmissionError):
     status = 504
 
 
-class EngineStopped(AdmissionError):
+class ServiceUnavailable(AdmissionError):
+    """The engine cannot take (or keep) this request for a reason
+    that is the SERVER's state, not the client's fault: a graceful
+    drain in progress, a circuit breaker holding admissions while
+    the KV pool rebuilds after a device fault, or a stop that caught
+    the request still queued.  503 + ``Retry-After`` — a well-behaved
+    client retries against the restarted/recovered replica instead
+    of dropping the request."""
+
+    status = 503
+
+
+class EngineStopped(ServiceUnavailable):
     """The engine is (being) shut down — the SERVER's state, so the
     client sees 503 Service Unavailable and retries the restarted
     instance, never a 400 that tells it to drop the request."""
-
-    status = 503
 
 
 class TokenBucket(object):

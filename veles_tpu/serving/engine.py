@@ -39,11 +39,12 @@ import time
 
 import numpy
 
+from .. import resilience
 from ..error import Bug
 from ..logger import Logger
 from ..resilience import Deadline
 from .admission import (DeadlineExceeded, EngineStopped,
-                        PoolExhausted, QueueFull)
+                        PoolExhausted, QueueFull, ServiceUnavailable)
 from .buckets import BucketPolicy, next_pow2
 from .metrics import ServingStats, register_engine, unregister_engine
 
@@ -55,7 +56,7 @@ class _Request(object):
     __slots__ = ("kind", "key", "rows", "x", "tokens", "length",
                  "max_new", "temperature", "seed", "deadline",
                  "result", "error", "event", "t_submit",
-                 "kv_commit", "row_results", "rows_done")
+                 "kv_commit", "row_results", "rows_done", "replays")
 
     def __init__(self, kind, key, rows, deadline):
         self.kind = kind
@@ -75,6 +76,7 @@ class _Request(object):
         self.kv_commit = 0         # blocks reserved at admission
         self.row_results = None    # per-row generated-token lists
         self.rows_done = 0
+        self.replays = 0           # supervised pool-rebuild replays
 
 
 class _PagedRow(object):
@@ -109,27 +111,82 @@ class ServingEngine(Logger):
 
     def __init__(self, model, max_batch=8, queue_depth=64,
                  policy=None, stats=None, default_deadline=30.0,
-                 paged=None, kv_blocks=None, kv_block_size=16):
+                 paged=None, kv_blocks=None, kv_block_size=16,
+                 injector=None, max_replays=2, breaker_limit=3,
+                 breaker_window=60.0, drain_timeout=30.0):
         super(ServingEngine, self).__init__()
-        self.model = model
         self.max_batch = int(max_batch)
         self.queue_depth = int(queue_depth)
-        # Cached once: ExportedModel.max_position re-parses the unit
-        # chain per access, too heavy for the per-request hot path.
-        self._max_position = getattr(model, "max_position", None)
-        self.policy = policy or BucketPolicy(
-            max_batch=self.max_batch,
-            prompt_cap=self._max_position)
+        self._policy_explicit = policy is not None
+        self._paged_arg = paged
         self.stats = stats or ServingStats()
         self.default_deadline = default_deadline
         self.kv_block_size = int(kv_block_size)
         self.kv_blocks = kv_blocks
         self.kv_pool = None
+        self._adopt_model(model, policy)
+        #: Fault injector consulted at the ``serve.device_fault`` /
+        #: ``serve.reload_corrupt`` points; None falls back to the
+        #: process-wide one (``--chaos`` plan).
+        self.injector = injector
+        #: Per-request supervised-recovery budget: how many pool
+        #: rebuilds a single request may be replayed through before
+        #: it fails with the device error.
+        self.max_replays = int(max_replays)
+        #: Circuit breaker: more than ``breaker_limit`` pool rebuilds
+        #: inside ``breaker_window`` seconds trips the engine to
+        #: permanent-fail (a device that faults this often is not
+        #: recovering; restarts/reschedules are the operator's move).
+        self.breaker_limit = int(breaker_limit)
+        self.breaker_window = float(breaker_window)
+        #: Default budget for ``stop(drain=True)``.
+        self.drain_timeout = float(drain_timeout)
+        #: Monotonic weight generation served by this engine — bumped
+        #: by every successful :meth:`reload` (in-place or
+        #: drain-and-swap) and surfaced as the ``weight_version``
+        #: gauge on /stats, /metrics, and the web-status serving row.
+        self.weight_version = int(getattr(model, "weight_version",
+                                          None) or 1)
+        self._pending = collections.deque()     # classify + dense gen
+        self._paged_wait = collections.deque()  # awaiting adoption
+        self._rows = []                         # active decode rows
+        self._kv_committed = 0                  # blocks reserved
+        self._cond = threading.Condition()
+        self._thread = None
+        self._stopped = False
+        self._draining = False
+        self._breaker = "closed"   # closed | rebuilding | tripped
+        self._rebuilds = collections.deque()  # rebuild timestamps
+        self._ops = collections.deque()       # device-thread ops
+        self._reload_waiting = False          # full swap quiescing
+        #: Device thread mid-iteration (a taken batch or an adoption
+        #: whose rows are not yet in ``_rows``): drain and quiesce
+        #: must wait on this too, or work in the adoption window
+        #: would be invisible to them and die at the hard stop.
+        self._busy = False
+        self._batch_ewma = {}  # kind -> recent device-batch cost
+
+    def _adopt_model(self, model, policy=None):
+        """Binds ``model`` as the served model: caches its geometry
+        and recomputes the paged-surface support and bucket policy —
+        shared by the constructor and the drain-and-swap reload
+        path."""
+        self.model = model
+        # Cached once: ExportedModel.max_position re-parses the unit
+        # chain per access, too heavy for the per-request hot path.
+        self._max_position = getattr(model, "max_position", None)
+        if policy is not None:
+            self.policy = policy
+        elif not self._policy_explicit:
+            self.policy = BucketPolicy(
+                max_batch=self.max_batch,
+                prompt_cap=self._max_position)
         supported = bool(
             self._max_position and
             hasattr(model, "make_kv_pool") and
             hasattr(model, "paged_extend") and
             hasattr(model, "paged_step"))
+        paged = self._paged_arg
         if paged is None:
             self.paged = supported
         else:
@@ -138,14 +195,6 @@ class ServingEngine(Logger):
                 raise Bug("paged decode requested but the model has "
                           "no paged surface (make_kv_pool / "
                           "paged_extend / paged_step + max_position)")
-        self._pending = collections.deque()     # classify + dense gen
-        self._paged_wait = collections.deque()  # awaiting adoption
-        self._rows = []                         # active decode rows
-        self._kv_committed = 0                  # blocks reserved
-        self._cond = threading.Condition()
-        self._thread = None
-        self._stopped = False
-        self._batch_ewma = {}  # kind -> recent device-batch cost
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -171,6 +220,8 @@ class ServingEngine(Logger):
             return self
         self._ensure_pool()
         self._stopped = False
+        self._draining = False
+        self.stats.set_gauge("weight_version", self.weight_version)
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name="veles-serving-device")
@@ -178,7 +229,59 @@ class ServingEngine(Logger):
         register_engine(self)
         return self
 
-    def stop(self):
+    #: Retry-After quoted to requests a non-draining stop() caught
+    #: still queued: the horizon a supervised restart usually needs
+    #: before the replacement replica takes traffic.
+    RESTART_RETRY_AFTER = 5.0
+
+    def stop(self, drain=False, timeout=None):
+        """Stops the engine.  ``drain=False`` (the default) cancels
+        everything immediately; ``drain=True`` is the graceful path:
+        admissions close (503 + ``Retry-After``), queued-but-
+        unstarted requests are failed with
+        :class:`~veles_tpu.serving.admission.ServiceUnavailable`
+        (their clients retry the restarted replica), live decode rows
+        run to completion up to ``timeout`` (default
+        :attr:`drain_timeout`), and the final stats are flushed to
+        the log before the device thread exits."""
+        if drain and self._thread is not None:
+            budget = self.drain_timeout if timeout is None else \
+                float(timeout)
+            with self._cond:
+                self._draining = True
+                live_reqs = {row.req for row in self._rows}
+                self._fail_queued_locked(
+                    "serving engine is draining for shutdown",
+                    retry_after=max(1.0, budget))
+                self._cond.notify_all()
+            deadline = Deadline(budget)
+            drained = True
+            while True:
+                with self._cond:
+                    # _busy covers the adoption window: requests the
+                    # device thread already took from the queue but
+                    # whose rows are not in _rows yet — they count
+                    # as live, or they would die at the hard stop.
+                    live = len(self._rows) + int(self._busy)
+                    live_reqs.update(row.req for row in self._rows)
+                if not live:
+                    break
+                if deadline.expired:
+                    drained = False
+                    self.warning("drain timeout: %d live decode "
+                                 "row(s) still running", live)
+                    break
+                time.sleep(0.005)
+            done = sum(1 for req in live_reqs
+                       if req.result is not None)
+            if done:
+                self.stats.incr("drained.requests", done)
+            if not drained:
+                self.stats.incr("drained.timeouts")
+            self.info("drain %s (%d request(s) decoded to "
+                      "completion) — final stats: %s",
+                      "complete" if drained else "timed out", done,
+                      self.stats.snapshot().get("counters"))
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
@@ -189,20 +292,151 @@ class ServingEngine(Logger):
         # Anything still queued or mid-decode is cancelled, not
         # silently dropped — a blocked submitter must wake with an
         # error (503: the server's state, retryable, never a client
-        # fault).
+        # fault).  Queued-but-unstarted requests get ServiceUnavail-
+        # able + Retry-After: a well-behaved client retries them
+        # verbatim against the restarting replica.
         for req in {row.req for row in self._rows}:
             self._fail_req(req, EngineStopped("serving engine "
                                               "stopped"))
+        with self._cond:
+            self._fail_queued_locked(
+                "serving engine stopped — retry against the "
+                "restarted replica",
+                retry_after=self.RESTART_RETRY_AFTER)
+        # Unblock any reload waiting on the device thread.
+        while self._ops:
+            op = self._ops.popleft()
+            op["error"] = EngineStopped("serving engine stopped")
+            op["event"].set()
+
+    def _fail_queued_locked(self, reason, retry_after):
+        """Fails every queued-but-unstarted request with 503 +
+        ``Retry-After`` (caller holds the lock)."""
         while self._pending:
             req = self._pending.popleft()
-            req.error = EngineStopped("serving engine stopped")
+            req.error = ServiceUnavailable(reason,
+                                           retry_after=retry_after)
             req.event.set()
         while self._paged_wait:
             req = self._paged_wait.popleft()
-            with self._cond:
-                self._kv_committed -= req.kv_commit
-            req.error = EngineStopped("serving engine stopped")
+            self._kv_committed -= req.kv_commit
+            req.error = ServiceUnavailable(reason,
+                                           retry_after=retry_after)
             req.event.set()
+
+    # -- hot weight reload -------------------------------------------------
+
+    def reload(self, model_or_path, timeout=60.0):
+        """Swaps in new weights WITHOUT dropping live streams.
+
+        ``model_or_path`` is an already-verified model object, a
+        path, or a file object holding an artifact.  Same-geometry
+        artifacts do an IN-PLACE weight swap applied by the device
+        thread at a decode-step boundary — the compile caches and the
+        KV pool survive (live rows keep their tables; only the
+        prompt-prefix cache is flushed, its entries hold old-weight
+        k/v); different-geometry artifacts fall back to
+        DRAIN-AND-SWAP: admissions close (503 + ``Retry-After``),
+        in-flight work runs to completion, then the whole model (and
+        pool) is replaced.  Returns the new monotonically-increased
+        :attr:`weight_version`.  Blocks up to ``timeout`` seconds;
+        raises whatever the swap raised (the old weights keep serving
+        on any failure)."""
+        new = model_or_path
+        if not hasattr(new, "weights"):
+            from ..export import ExportedModel
+            new = ExportedModel(new)
+        try:
+            same = bool(self.model.same_geometry(new))
+        except AttributeError:
+            same = False  # duck-typed model: full swap only
+        if self._thread is None:
+            return self._apply_reload(new, same)
+        op = {"new": new, "same": same, "event": threading.Event(),
+              "result": None, "error": None}
+        with self._cond:
+            if self._stopped:
+                raise EngineStopped("serving engine is not running")
+            self._ops.append(op)
+            self._cond.notify_all()
+        if not op["event"].wait(timeout):
+            # CANCEL the op: a reload the caller was told failed
+            # must never land later behind their back (an operator
+            # retry would then double-apply).  If it cannot be
+            # removed, the device thread is applying it RIGHT NOW —
+            # wait briefly for the definitive outcome instead.
+            with self._cond:
+                try:
+                    self._ops.remove(op)
+                    cancelled = True
+                except ValueError:
+                    cancelled = False
+                if cancelled and not self._ops:
+                    # Admissions were closed for a pending full
+                    # swap; with the queue now empty nobody else
+                    # owns that hold — reopen.  (Remaining ops keep
+                    # it: their own apply/cancel clears it.)
+                    self._reload_waiting = False
+                self._cond.notify_all()
+            if not cancelled and op["event"].wait(10.0):
+                if op["error"] is not None:
+                    raise op["error"]
+                return op["result"]
+            raise ServiceUnavailable(
+                "reload cancelled: live work did not quiesce within "
+                "%gs" % timeout, retry_after=timeout)
+        if op["error"] is not None:
+            raise op["error"]
+        return op["result"]
+
+    def _apply_reload_op(self, op):
+        try:
+            op["result"] = self._apply_reload(op["new"], op["same"])
+        except Exception as e:  # surfaced to the reload() caller
+            self.exception("reload failed — old weights keep serving")
+            op["error"] = e
+        finally:
+            with self._cond:
+                self._reload_waiting = False
+                self._cond.notify_all()
+            op["event"].set()
+
+    def _apply_reload(self, new, same):
+        t0 = time.monotonic()
+        if same:
+            self.model.swap_weights(new.weights)
+            if self.kv_pool is not None:
+                dropped = self.kv_pool.drop_prefixes()
+                if dropped:
+                    self.debug("reload: flushed %d cached prefixes",
+                               dropped)
+            self.stats.incr("reload.inplace")
+        else:
+            # The device thread only applies a full swap once the
+            # engine is quiet, so nothing references the old model or
+            # pool anymore.  Adoption can still FAIL (explicit
+            # paged=True against a surface-less artifact, pool build
+            # OOM) — restore every mutated binding so "old weights
+            # keep serving" stays true.
+            old = (self.model, self._max_position, self.policy,
+                   self.paged, self.kv_pool)
+            try:
+                self._adopt_model(new)
+                self.kv_pool = None
+                self._ensure_pool()
+            except BaseException:
+                (self.model, self._max_position, self.policy,
+                 self.paged, self.kv_pool) = old
+                raise
+            self.stats.incr("reload.swap")
+        self.weight_version += 1
+        self.stats.set_gauge("weight_version", self.weight_version)
+        self.stats.observe_latency("reload.apply",
+                                   time.monotonic() - t0)
+        self.info("weights reloaded (%s) -> version %d",
+                  "in-place" if same else "drain-and-swap",
+                  self.weight_version)
+        return self.weight_version
 
     def queue_depth_now(self):
         with self._cond:
@@ -244,10 +478,36 @@ class ServingEngine(Logger):
 
     # -- submission (HTTP handler threads) ---------------------------------
 
+    def _admission_gate_locked(self):
+        """The server-state checks every submission passes before it
+        may cost a queue slot: a stopped engine, a drain in progress,
+        and the supervised-recovery circuit breaker (503 +
+        ``Retry-After`` while the KV pool rebuilds; permanent-fail
+        once tripped)."""
+        if self._stopped:
+            raise EngineStopped("serving engine is not running")
+        if self._draining or self._reload_waiting:
+            self.stats.incr("rejected.draining")
+            raise ServiceUnavailable(
+                "serving engine is %s — retry shortly" %
+                ("draining" if self._draining
+                 else "swapping models"),
+                retry_after=max(1.0, self._drain_estimate_locked()))
+        if self._breaker == "tripped":
+            self.stats.incr("rejected.breaker")
+            raise ServiceUnavailable(
+                "circuit breaker tripped: %d KV pool rebuilds inside "
+                "%.0f s — the device is not recovering" %
+                (len(self._rebuilds), self.breaker_window))
+        if self._breaker == "rebuilding":
+            self.stats.incr("rejected.breaker")
+            raise ServiceUnavailable(
+                "KV pool rebuilding after a device fault",
+                retry_after=1.0)
+
     def _enqueue(self, req):
         with self._cond:
-            if self._stopped:
-                raise EngineStopped("serving engine is not running")
+            self._admission_gate_locked()
             if len(self._pending) >= self.queue_depth:
                 self.stats.incr("rejected.queue_full")
                 raise QueueFull(
@@ -390,8 +650,7 @@ class ServingEngine(Logger):
         req.kv_commit = per_row * req.rows
         req.row_results = [None] * req.rows
         with self._cond:
-            if self._stopped:
-                raise EngineStopped("serving engine is not running")
+            self._admission_gate_locked()
             pool = self._ensure_pool()
             if req.kv_commit > pool.usable:
                 raise Bug(
@@ -438,19 +697,48 @@ class ServingEngine(Logger):
         while True:
             with self._cond:
                 while not (self._pending or self._paged_wait or
-                           self._rows or self._stopped):
+                           self._rows or self._ops or self._stopped):
                     self._cond.wait(0.5)
                 if self._stopped:
                     return
-                batch = self._take_batch_locked() if self._pending \
-                    else None
-                adopt = self._take_paged_locked()
-            if adopt:
-                self._paged_prefill(adopt)
-            if batch:
-                self._execute(batch)
+                op = None
+                if self._ops:
+                    head = self._ops[0]
+                    if head["same"] or self._quiet_locked():
+                        # In-place swaps apply at ANY decode-step
+                        # boundary; a full model swap waits for the
+                        # engine to quiesce (drain-and-swap) with
+                        # admissions closed meanwhile.
+                        op = self._ops.popleft()
+                    else:
+                        self._reload_waiting = True
+                batch = None
+                adopt = []
+                if op is None:
+                    if self._pending:
+                        batch = self._take_batch_locked()
+                    adopt = self._take_paged_locked()
+                self._busy = bool(batch or adopt)
+            if op is not None:
+                self._apply_reload_op(op)
+                continue
+            try:
+                if adopt:
+                    self._paged_prefill(adopt)
+                if batch:
+                    self._execute(batch)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
             if self._rows:
                 self._paged_step_once()
+
+    def _quiet_locked(self):
+        """No queued, adopting, or live work — the drain-and-swap
+        quiesce condition (caller holds the lock)."""
+        return not (self._pending or self._paged_wait or
+                    self._rows or self._busy)
 
     def _take_batch_locked(self):
         """Head-of-queue plus every compatible waiting request, up to
@@ -507,6 +795,11 @@ class ServingEngine(Logger):
             return
         t0 = time.monotonic()
         try:
+            # Dense batches carry no cross-request device state: a
+            # fault (injected or real) fails THIS batch only and the
+            # clients retry — no pool rebuild needed.
+            resilience.effective(self.injector).check(
+                "serve.device_fault")
             if live[0].kind == "classify":
                 self._run_classify(live)
             else:
@@ -637,7 +930,7 @@ class ServingEngine(Logger):
             self._run_paged_extend(rows)
         except Exception as e:
             self.exception("paged prefill failed")
-            self._paged_wreck(rows, e)
+            self._recover_prefill_fault(rows, e)
             return
         now = time.monotonic()
         live = []
@@ -701,8 +994,14 @@ class ServingEngine(Logger):
         row.prefix_chain = chain
         return row
 
-    def _run_paged_extend(self, rows):
-        """One coalesced chunk-prefill call for every adopted row."""
+    def _run_paged_extend(self, rows, replay=False):
+        """One coalesced chunk-prefill call for every adopted row.
+        ``replay=True`` is the supervised-recovery path: a row that
+        already emitted tokens keeps its (tok, gen) state — the
+        freshly sampled token is discarded, because the request
+        already holds it and the NEXT step must sample at PRNG fold
+        index ``len(gen)``, exactly where the uninjected run would
+        be."""
         pool = self.kv_pool
         n = len(rows)
         B = self.policy.batch_bucket(n)
@@ -727,6 +1026,8 @@ class ServingEngine(Logger):
             temps[at] = req.temperature
             seeds[at] = (req.seed + row.row_idx) & 0xFFFFFFFF
         t0 = time.monotonic()
+        resilience.effective(self.injector).check(
+            "serve.device_fault")
         tok0 = self.model.paged_extend(pool, tables, tokens, prior,
                                        clens, temps, seeds)
         dt = time.monotonic() - t0
@@ -735,9 +1036,12 @@ class ServingEngine(Logger):
         # it feeds the "generate" drain estimate.
         self._note_ewma("generate", dt)
         for at, row in enumerate(rows):
-            row.tok = int(tok0[at])
-            row.gen = [row.tok]
             row.pos = row.prior + len(row.chunk)
+            if replay and row.gen:
+                row.tok = row.gen[-1]
+            else:
+                row.tok = int(tok0[at])
+                row.gen = [row.tok]
 
     def _paged_step_once(self):
         """Advance every active decode row one token — the heart of
@@ -784,11 +1088,13 @@ class ServingEngine(Logger):
             seeds[at] = (req.seed + row.row_idx) & 0xFFFFFFFF
         t0 = time.monotonic()
         try:
+            resilience.effective(self.injector).check(
+                "serve.device_fault")
             new_tok = self.model.paged_step(pool, tables, pos, tok,
                                             gen_idx, temps, seeds)
         except Exception as e:
             self.exception("paged decode step failed")
-            self._paged_wreck(rows, e)
+            self._supervised_recover(rows, e)
             return
         dt = time.monotonic() - t0
         self.stats.observe_batch("decode", n, dt)
@@ -863,21 +1169,204 @@ class ServingEngine(Logger):
             req.error = error
         req.event.set()
 
-    def _paged_wreck(self, rows, error):
-        """A paged device call failed: the pool's storage may be in
-        an undefined (half-donated) state, so fail every request that
-        had rows in flight and rebuild the pool from scratch —
-        correctness over cached prefixes."""
-        for req in {row.req for row in rows} | \
-                {row.req for row in self._rows}:
-            self._fail_req(req, error)
+    # -- supervised decode recovery ----------------------------------------
+
+    #: Breaker-state gauge encoding for ``serving.breaker_state``.
+    BREAKER_STATES = {"closed": 0, "rebuilding": 1, "tripped": 2}
+
+    def _supervised_recover(self, rows, error):
+        """A paged device call failed mid-decode.  The pool's device
+        storage is in an undefined (half-donated) state, so it is
+        rebuilt from scratch — but live requests are NOT failed: each
+        generate request holds its prompt and every emitted token, so
+        after the rebuild surviving rows are re-adopted by replaying
+        prompt+emitted through ``paged_extend`` and decode resumes
+        TOKEN-IDENTICALLY (deadline- and replay-budget-aware).  The
+        circuit breaker answers new submissions with 503 +
+        ``Retry-After`` while rebuilding, and trips to permanent-fail
+        past ``breaker_limit`` rebuilds per ``breaker_window``
+        seconds — a device faulting that often is not recovering."""
         pool = self.kv_pool
+        now = time.monotonic()
+        with self._cond:
+            all_rows = list(self._rows)
+            for row in rows:
+                if row not in all_rows:
+                    all_rows.append(row)
+            self._rows = []
+            for row in all_rows:
+                # Claim every table: the ids reference the pool
+                # generation being discarded — releasing them into
+                # the REBUILT pool would corrupt its accounting.
+                row.table = None
+            # The recovery window counts as LIVE work: _rows is
+            # empty until re-adoption lands, and a concurrent
+            # drain/quiesce poll reading 0 here would hard-stop and
+            # kill the streams the supervisor is about to save.
+            self._busy = True
+            self._rebuilds.append(now)
+            while self._rebuilds and \
+                    self._rebuilds[0] < now - self.breaker_window:
+                self._rebuilds.popleft()
+            tripped = len(self._rebuilds) > self.breaker_limit
+            self._breaker = "tripped" if tripped else "rebuilding"
+        try:
+            self._recover_locked_out(all_rows, error, pool, tripped)
+        finally:
+            with self._cond:
+                self._busy = False
+                self._cond.notify_all()
+
+    def _recover_locked_out(self, all_rows, error, pool, tripped):
+        """The body of :meth:`_supervised_recover` past the row
+        claim, split out so the ``_busy`` window wraps it exactly."""
+        if tripped:
+            self.warning(
+                "circuit breaker TRIPPED: %d KV pool rebuilds inside "
+                "%.0f s — failing live paged work permanently",
+                len(self._rebuilds), self.breaker_window)
+            self.stats.incr("breaker.trips")
+            for req in {row.req for row in all_rows}:
+                self._fail_req(req, error)
+            with self._cond:
+                waiting = list(self._paged_wait)
+                self._paged_wait.clear()
+                for req in waiting:
+                    self._kv_committed -= req.kv_commit
+            for req in waiting:
+                req.error = ServiceUnavailable(
+                    "circuit breaker tripped after repeated device "
+                    "faults")
+                req.event.set()
+            self._update_gauges()
+            return
+        self.warning("device fault during paged decode (%s) — "
+                     "rebuilding the KV pool, re-adopting %d live "
+                     "row(s)", error, len(all_rows))
         self.stats.incr("kv.pool.resets")
+        self.stats.incr("breaker.rebuilds")
         self.kv_pool = self.model.make_kv_pool(pool.n_blocks,
                                                pool.block_size)
+        by_req = {}
+        for row in all_rows:
+            by_req.setdefault(row.req, []).append(row)
+        replayable = []
+        for req, req_rows in by_req.items():
+            req.replays += 1
+            if req.deadline is not None and req.deadline.expired:
+                self.stats.incr("cancelled.deadline")
+                self._fail_req(req, DeadlineExceeded(
+                    "deadline expired during KV pool rebuild"))
+            elif req.replays > self.max_replays:
+                self.stats.incr("readopt.exhausted")
+                self._fail_req(req, error)
+            else:
+                replayable.extend(req_rows)
+        self._readopt_rows(replayable)
+        with self._cond:
+            if self._breaker == "rebuilding":
+                self._breaker = "closed"
+            self._cond.notify_all()
         self._update_gauges()
 
+    def _readopt_rows(self, rows):
+        """Replays surviving rows into the REBUILT pool: each row's
+        chunk is its prompt plus every emitted token but the last, so
+        one ``paged_extend`` recomputes exactly the k/v the dead pool
+        held; the freshly sampled token is discarded (``replay=True``
+        — the request already holds it) and the next decode step
+        samples with PRNG fold index ``len(gen)``, the same stream
+        position the uninjected run would use.  A request that
+        cannot be re-seated (pool too fragmented — structurally rare,
+        reservations are still held) fails atomically."""
+        if not rows:
+            return 0
+        pool = self.kv_pool
+        ok = []
+        failed = {}
+        for row in rows:
+            req = row.req
+            if req in failed:
+                continue
+            tokens_row = numpy.asarray(req.tokens[row.row_idx],
+                                       dtype=numpy.int32)
+            emitted = list(row.gen or ())
+            if emitted:
+                chunk = numpy.concatenate(
+                    [tokens_row[:req.length],
+                     numpy.asarray(emitted[:-1], numpy.int32)])
+            else:
+                chunk = tokens_row[:req.length]
+            total_blocks = pool.blocks_for(req.length + req.max_new)
+            fresh = pool.alloc(total_blocks)
+            if fresh is None:
+                failed[req] = ServiceUnavailable(
+                    "KV pool exhausted during re-adoption",
+                    retry_after=1.0)
+                continue
+            row.table = fresh
+            row.n_blocks = total_blocks
+            row.prior = 0
+            row.chunk = chunk
+            row.prefix_chain = None
+            ok.append(row)
+        if failed:
+            for row in list(ok):
+                if row.req in failed:
+                    ok.remove(row)
+                    self._release_row_blocks(row)
+            for req, err in failed.items():
+                self._fail_req(req, err)
+        if not ok:
+            return 0
+        try:
+            self._run_paged_extend(ok, replay=True)
+        except Exception as e:
+            # A second fault during recovery: the per-request replay
+            # budget and the breaker bound the recursion.
+            self.exception("re-adoption prefill failed")
+            self._supervised_recover(ok, e)
+            return 0
+        self.stats.incr("readopt.rows", len(ok))
+        retired = [r for r in ok if len(r.gen) >= r.req.max_new]
+        live = [r for r in ok if len(r.gen) < r.req.max_new]
+        if live:
+            with self._cond:
+                self._rows.extend(live)
+        for row in retired:
+            self._retire_row(row)
+        return len(ok)
+
+    def _recover_prefill_fault(self, rows, error):
+        """Prefill hit a device fault: the adopting requests have no
+        reliably-emitted tokens yet, so they go back to the FRONT of
+        the wait queue (their block reservations stay held) and ride
+        the normal adoption path once the pool is rebuilt; active
+        decode rows are re-adopted by replay.  A request past its
+        replay budget fails with the device error instead of
+        requeueing forever."""
+        reqs = []
+        with self._cond:
+            for row in rows:
+                row.table = None  # dead pool generation
+                if row.req not in reqs:
+                    reqs.append(row.req)
+        requeue = []
+        for req in reqs:
+            req.replays += 1
+            if req.replays > self.max_replays:
+                self.stats.incr("readopt.exhausted")
+                self._fail_req(req, error)
+            else:
+                requeue.append(req)
+        with self._cond:
+            for req in reversed(requeue):
+                self._paged_wait.appendleft(req)
+        self._supervised_recover([], error)
+
     def _update_gauges(self):
+        self.stats.set_gauge("breaker_state",
+                             self.BREAKER_STATES[self._breaker])
         pool = self.kv_pool
         if pool is None:
             return

@@ -217,6 +217,15 @@ def live_serving_summary():
                e.stats.tokens_per_second() for e in engines), 2),
            "queue_depth": sum(
                e.queue_depth_now() for e in engines)}
+    versions = [e.weight_version for e in engines
+                if getattr(e, "weight_version", None)]
+    if versions:
+        out["weight_version"] = max(versions)
+    breakers = {getattr(e, "_breaker", "closed") for e in engines}
+    if breakers - {"closed"}:
+        # Degraded state leads the row: a rebuilding/tripped breaker
+        # is exactly what the operator opened the dashboard for.
+        out["breaker"] = sorted(breakers - {"closed"})[0]
     used = total = 0
     for e in engines:
         pool = getattr(e, "kv_pool", None)

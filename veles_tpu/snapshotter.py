@@ -72,6 +72,14 @@ def init_parser(parser):
              "holds a *_current.lnk pointer, resume from the newest "
              "VERIFIED snapshot generation instead of starting fresh "
              "(no-op when -s is given or no snapshot exists)")
+    parser.add_argument(
+        "--snapshot-artifact", action="store_true",
+        help="continuous deployment: alongside every snapshot, "
+             "export the workflow's forward chain as a serving "
+             "artifact (<blob>.veles.tgz + sha256 manifest sidecar) "
+             "— a serving replica watching <prefix>_current.lnk "
+             "(--serve-reload-watch) hot-deploys each verified "
+             "generation (sets root.common.snapshotter.artifact)")
 
 
 CODECS = {
@@ -132,6 +140,26 @@ def workflow_is_finite(workflow):
 def manifest_path(path):
     """The sidecar manifest path for a snapshot blob."""
     return path + MANIFEST_SUFFIX
+
+
+def write_manifest_sidecar(path, manifest):
+    """Writes ``path``'s sidecar manifest atomically (temp +
+    ``os.replace``) — shared by the snapshot and serving-artifact
+    writers: resume and the deploy gate trust the checksum, so a
+    torn manifest must never exist."""
+    mpath = manifest_path(path)
+    tmp = mpath + ".part"
+    try:
+        with open(tmp, "w") as fout:
+            json.dump(manifest, fout, indent=1, sort_keys=True)
+        os.replace(tmp, mpath)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return manifest
 
 
 def read_manifest(path):
@@ -198,8 +226,11 @@ def iter_generations(directory, prefix):
     seen = set()
     for pattern in (prefix + ".pickle*", prefix + "_*.pickle*"):
         for path in glob.glob(os.path.join(directory, pattern)):
-            if path.endswith((MANIFEST_SUFFIX, ".part", ".lnk")) or \
-                    path in seen:
+            # .veles.tgz: a snapshot's SIBLING SERVING ARTIFACT
+            # (--snapshot-artifact) shares the blob's name stem — it
+            # is a deploy artifact, never a resumable generation.
+            if path.endswith((MANIFEST_SUFFIX, ".part", ".lnk",
+                              ".veles.tgz")) or path in seen:
                 continue
             seen.add(path)
             manifest = read_manifest(path)
@@ -261,6 +292,11 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
         self.skip = kwargs.get("skip", False)
         self.keep = int(kwargs.get(
             "keep", root.common.snapshotter.get("keep", 3)))
+        #: Continuous deployment (``--snapshot-artifact``): export a
+        #: verified serving artifact next to every snapshot blob.
+        self.export_artifact = bool(kwargs.get(
+            "artifact", root.common.snapshotter.get("artifact",
+                                                    False)))
         super(SnapshotterBase, self).__init__(workflow, **kwargs)
         # After super().__init__ — it runs init_unpickled, which
         # clears the transient injector slot.
@@ -425,6 +461,11 @@ class SnapshotterToFile(SnapshotterBase):
         except resilience.InjectedSnapshotCorruption:
             corrupt_file(path)
             self.warning("chaos: flipped one byte of %s", path)
+        if self.export_artifact:
+            # BEFORE the pointer moves: a serving replica watching
+            # _current.lnk must never resolve a pointer whose
+            # artifact is still being written.
+            self._export_serving_artifact(path)
         self.destination = path
         self._update_current_link(path)
         resilience.stats.incr("snapshot.write")
@@ -486,27 +527,55 @@ class SnapshotterToFile(SnapshotterBase):
             "finite": workflow_is_finite(self.workflow),
         }
         manifest.update(self.describe())
-        mpath = manifest_path(path)
-        tmp = mpath + ".part"
+        return write_manifest_sidecar(path, manifest)
+
+    def _export_serving_artifact(self, path):
+        """The train→serve hot-deploy hook: exports the workflow's
+        forward chain as a serving artifact next to the snapshot blob
+        (``<blob>.veles.tgz`` + sha256 sidecar manifest, atomic
+        temp+replace like everything else here).  A serving replica
+        following this family's ``_current.lnk`` verifies the
+        manifest and hot-swaps the weights in (docs/serving.md
+        "Operations").  Workflows without an exportable forward
+        chain — or transient export failures — log and skip: losing
+        one deploy generation must never fail the training snapshot
+        that carries it."""
+        from .export import export_workflow
+        from .serving.reload import ARTIFACT_SUFFIX
+        apath = path + ARTIFACT_SUFFIX
+        tmp = apath + ".part"
         try:
-            with open(tmp, "w") as fout:
-                json.dump(manifest, fout, indent=1, sort_keys=True)
-            os.replace(tmp, mpath)
-        except BaseException:
+            export_workflow(self.workflow, tmp)
+            digest = sha256_file(tmp)
+            size = os.path.getsize(tmp)
+            os.replace(tmp, apath)
+            manifest = {
+                "format": MANIFEST_FORMAT,
+                "kind": "serving-artifact",
+                "sha256": digest,
+                "size": size,
+                "prefix": self.prefix,
+                "created": time.time(),
+            }
+            manifest.update(self.describe())
+            write_manifest_sidecar(apath, manifest)
+            resilience.stats.incr("snapshot.artifact")
+            self.info("serving artifact -> %s", apath)
+        except Exception as e:
             try:
                 os.unlink(tmp)
             except OSError:
                 pass
-            raise
-        return manifest
+            self.warning("serving-artifact export skipped: %s", e)
 
     def prune(self):
         """Deletes generations beyond ``keep`` (oldest first), with
-        their manifests.  The newest generation — the one
-        ``_current.lnk`` names — always survives; ``keep <= 0``
-        disables pruning."""
+        their manifests and sibling serving artifacts.  The newest
+        generation — the one ``_current.lnk`` names — always
+        survives; ``keep <= 0`` disables pruning."""
         if self.keep <= 0:
             return
+        from .serving.reload import ARTIFACT_SUFFIX
         for path in iter_generations(self.directory,
                                      self.prefix)[self.keep:]:
             try:
@@ -518,10 +587,13 @@ class SnapshotterToFile(SnapshotterBase):
                 self.warning("cannot prune %s (%s) — kept with its "
                              "manifest", path, e)
                 continue
-            try:
-                os.unlink(manifest_path(path))
-            except OSError:
-                pass
+            for extra in (manifest_path(path),
+                          path + ARTIFACT_SUFFIX,
+                          manifest_path(path + ARTIFACT_SUFFIX)):
+                try:
+                    os.unlink(extra)
+                except OSError:
+                    pass
             resilience.stats.incr("snapshot.prune")
             self.info("pruned snapshot generation %s", path)
 
