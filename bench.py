@@ -76,7 +76,7 @@ A100_MLP_IMG_PER_SEC = 1.5e6
 #: exist here or in a real parser.
 BENCH_FLAGS = ("--mlp", "--lm", "--lm-toy", "--serve", "--streamed",
                "--streamed-jpeg", "--attn-stages", "--serve-streams",
-               "--serve-seconds", "--trace-out")
+               "--serve-seconds", "--trace-out", "--optimizer")
 
 # Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
 # or blocks gain <3% more.  The perf levers that got here: banded-
@@ -771,6 +771,74 @@ def trace_one_step(wf, path):
     return round(spans[-1]["dur"] / 1000.0, 3)
 
 
+def parse_optimizer(argv):
+    """``--optimizer=adam`` → sets the engine default so every GD
+    unit of the benched workflow uses the named rule (sgd default);
+    returns the name for the JSON line."""
+    name = "sgd"
+    for arg in argv:
+        if arg.startswith("--optimizer="):
+            name = arg.split("=", 1)[1]
+    from veles_tpu.znicz import optimizers
+    optimizers.get(name)  # actionable error on unknown names
+    from veles_tpu.config import root
+    root.common.engine.optimizer = name
+    return name
+
+
+def measure_update_ms(wf, repeats=10):
+    """Device milliseconds of ONE optimizer update phase: the step
+    compiler's apply_updates closure jitted alone over the model's
+    real params/slots (zero grads — the update rule's cost does not
+    depend on gradient values).  This is the ``optimizer_state_
+    bytes`` sibling number: what the chosen rule costs per dispatch,
+    isolated from forward/backward."""
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    c = wf.compiler
+    if not c._compiled:
+        c.compile()
+    _run_forward, apply_updates, _block = c._core_
+    params = {n: v.devmem for n, v in c._param_vecs.items()}
+    states = {n: v.devmem for n, v in c._state_vecs.items()}
+    grads = {n: jnp.zeros_like(v) for n, v in params.items()}
+    fn = jax.jit(
+        lambda p, s, g: apply_updates(p, g, dict(s), None))
+
+    def sync(res):
+        new_p, _new_s = res
+        numpy.array(jax.device_get(
+            next(iter(new_p.values())).ravel()[0]))
+
+    sync(fn(params, states, grads))  # warm/compile
+    t0 = time.time()
+    for _ in range(repeats):
+        out = fn(params, states, grads)
+    sync(out)
+    return round((time.time() - t0) / repeats * 1e3, 3)
+
+
+def optimizer_fields(wf, name):
+    """Optimizer columns for the bench JSON line: kind, total slot
+    bytes, isolated update-phase device ms, and (distributed runs
+    only) the slot-shard wire bytes counter — None on single-node
+    benches, where no slot traffic exists."""
+    from veles_tpu import resilience
+    from veles_tpu.znicz.nn_units import GradientDescentBase
+    state_bytes = sum(
+        vec.nbytes for u in wf.units
+        if isinstance(u, GradientDescentBase)
+        for vec in u.tstate.values())
+    slot_wire = resilience.stats.get("net.slot_bytes")
+    return {
+        "optimizer": name,
+        "optimizer_state_bytes": int(state_bytes),
+        "update_device_ms": measure_update_ms(wf),
+        "slot_wire_bytes": int(slot_wire) if slot_wire else None,
+    }
+
+
 def attribution_fields():
     """Live device-time/MFU gauge readings for the bench JSON line
     (the BENCH_r06 per-stage attribution record)."""
@@ -859,6 +927,7 @@ def main():
         # the JSON line so per-stage attribution is in the record.
         stages = parse_attn_stages(sys.argv)
         apply_attn_stages(stages)
+        opt_name = parse_optimizer(sys.argv)
         # MFU denominator for the live attribution gauge: the same
         # v5e peak the analytic MFU below uses, so the two numbers
         # are directly comparable on the JSON line.
@@ -916,9 +985,11 @@ def main():
             "step_wall_ms": step_wall_ms,
             "trace_out": trace_out,
             **attribution_fields(),
+            **optimizer_fields(wf, opt_name),
         }))
         return
     if "--mlp" in sys.argv:
+        opt_name = parse_optimizer(sys.argv)
         _, wf = build_mlp()
         ips = measure(wf, epochs=3)
         print(json.dumps({
@@ -926,6 +997,7 @@ def main():
             "value": round(ips, 1),
             "unit": "images/sec",
             "vs_baseline": round(ips / A100_MLP_IMG_PER_SEC, 4),
+            **optimizer_fields(wf, opt_name),
         }))
         return
     _, wf = build_alexnet()

@@ -185,6 +185,12 @@ class Main(Logger, CommandLineBase):
             out += ["--net-dtype", a.net_dtype]
         if a.net_legacy:
             out.append("--net-legacy")
+        if a.net_zero is not None:
+            out += ["--net-zero", str(a.net_zero)]
+        if a.optimizer is not None:
+            # Workers must build the same GD units (same slot shapes)
+            # as the master or the slot-shard sync cannot decode.
+            out += ["--optimizer", a.optimizer]
         return out + ["-m", "{master}"]
 
     def _launcher_kwargs(self):
@@ -337,10 +343,24 @@ class Main(Logger, CommandLineBase):
                 raise Bug("--job-ticks must be >= 1 (got %d)"
                           % args.job_ticks)
             root.common.net.job_ticks = args.job_ticks
+        if args.net_zero is not None:
+            if args.net_zero < 0:
+                raise Bug("--net-zero must be >= 0 (got %d)"
+                          % args.net_zero)
+            root.common.net.zero = args.net_zero
         if args.net_legacy:
             root.common.net.mode = "legacy"
         if args.net_require:
             root.common.net.require = True
+        # Optimizer family + ZeRO sharding (znicz.optimizers
+        # init_parser; docs/optimizers.md): the optimizer default is
+        # read back at GD-unit construction (and checked against
+        # resumed slots at initialize), --zero by the distributed
+        # launcher after the dp mesh is applied.
+        if args.optimizer is not None:
+            root.common.engine.optimizer = args.optimizer
+        if args.zero is not None:
+            root.common.engine.zero = args.zero
         # Observability knobs (observability.init_parser;
         # docs/observability.md): --trace-out arms span tracing (the
         # launcher exports at run end; workers enable via handshake),

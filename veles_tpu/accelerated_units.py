@@ -286,6 +286,25 @@ class StepCompiler(object):
 
     # -- compilation -------------------------------------------------------
 
+    def _note_optimizer_stats(self):
+        """Publishes the optimizer observability gauges
+        (``optimizer.state_bytes`` / ``optimizer.shard_frac`` with an
+        ``optimizer.kind`` label — heartbeat perf section, web_status
+        perf row, /metrics): one cheap walk per compile, not per
+        dispatch.  Called from compile() after analyze()."""
+        gds = list(dict.fromkeys(self.gd_map.values()))
+        if not gds:
+            return
+        kinds = sorted({getattr(gd, "optimizer", "sgd")
+                        for gd in gds})
+        state_bytes = sum(vec.nbytes for gd in gds
+                          for vec in gd.tstate.values())
+        zero = getattr(self.workflow, "_zero_", None)
+        shard_frac = 1.0 / zero[1] if zero and zero[1] else 1.0
+        from .observability import attribution
+        attribution.note_optimizer("+".join(kinds), state_bytes,
+                                   shard_frac)
+
     def fingerprint(self):
         """Shapes/dtypes of all step tensors — recompile trigger."""
         parts = []
@@ -328,6 +347,14 @@ class StepCompiler(object):
         # False at initialize; changing it later needs invalidate()).
         device_skip = bool(getattr(self.workflow,
                                    "health_device_skip", True))
+        # ZeRO-2 (parallel.apply_zero_sharding level 2): sharding
+        # constraints pinning each slot-backed gradient to its slot's
+        # data-axis layout, so XLA lowers the gradient psum to a
+        # reduce-scatter feeding the sharded update instead of a full
+        # all-reduce + slice.
+        zero_grad_specs = dict(getattr(
+            self.workflow, "_zero_grad_shardings_", None) or {})
+        self._note_optimizer_stats()
 
         def global_grad_norm(grads):
             import jax.numpy as jnp
@@ -426,6 +453,13 @@ class StepCompiler(object):
             in block mode; ``hypers`` optionally overrides the GD
             hyperparameters with traced scalars (population path)."""
             import jax.numpy as jnp
+            if zero_grad_specs:
+                from jax import lax
+                grads = {
+                    k: lax.with_sharding_constraint(
+                        g, zero_grad_specs[k])
+                    if k in zero_grad_specs else g
+                    for k, g in grads.items()}
             new_params = dict(params)
             for u in forward_units:
                 if not u.trainables:

@@ -28,6 +28,7 @@ WORKER_CAPS = {
     "delta": True,         # delta weight sync (both directions)
     "block": True,         # multi-tick jobs (fused scan-block)
     "trace": True,         # span shipping + clock-sync timestamps
+    "slots": True,         # ZeRO slot-shard sync (--net-zero)
     "codecs": ("none", "gzip"),
     "dtypes": ("fp32", "bf16"),
 }

@@ -36,21 +36,37 @@ from ..error import Bug
 from ..launcher import Launcher
 from ..loader.base import TRAIN, VALID
 
-#: Tune leaf names the vmapped path can turn into traced step inputs.
-HYPER_ATTRS = frozenset((
+#: The classic GD hyper leaves (always tunable).
+BASE_HYPER_ATTRS = frozenset((
     "learning_rate", "learning_rate_bias",
     "weights_decay", "weights_decay_bias",
     "gradient_moment", "gradient_moment_bias",
 ))
 
 
+def hyper_attrs():
+    """Tune leaf names the vmapped path can turn into traced step
+    inputs: the classic lr/decay/moment set plus every registered
+    optimizer's extra hypers (Adam betas/eps, Lion betas — the
+    optimizer registry is the single source of truth, so a new
+    optimizer's hypers become GA-tunable by declaration)."""
+    from ..znicz.optimizers import OPTIMIZERS
+    names = set(BASE_HYPER_ATTRS)
+    for opt in OPTIMIZERS.values():
+        names.update(opt.EXTRA_HYPERS)
+    return frozenset(names)
+
+
+
+
 def hyper_names(tunes):
     """The traced-hyper layout for a tune set, or ``None`` when any
-    tune is not a (uniquely named) GD hyperparameter."""
+    tune is not a (uniquely named) GD/optimizer hyperparameter."""
+    attrs = hyper_attrs()
     names = []
     for path, _tune in tunes:
         leaf = path.rsplit(".", 1)[-1]
-        if leaf not in HYPER_ATTRS or leaf in names:
+        if leaf not in attrs or leaf in names:
             return None
         names.append(leaf)
     return tuple(names) if names else None
@@ -115,15 +131,45 @@ class PopulationEvaluator(object):
             # accumulators).
             raise Bug("population evaluation needs an EvaluatorBase "
                       "epoch accumulator in the traced chain")
-        if "gradient_moment" in self.names or \
-                "gradient_moment_bias" in self.names:
-            has_velocity = any("/velocity_" in n
-                               for n in compiler._state_vecs)
-            if not has_velocity:
+        self._check_tuned_hypers()
+
+    def _check_tuned_hypers(self):
+        """Registry-driven validation of the tuned hyper set: every
+        tuned name must be CONSUMED by at least one GD unit's
+        optimizer (tuning Adam betas under momentum-SGD units would
+        silently tune nothing), and slot-backed hypers (sgd's
+        gradient_moment needs velocity slots) must have their slots
+        allocated — the reference check, generalized from the
+        hardcoded gradient_moment/velocity_ pair to whatever the
+        optimizer registry declares."""
+        from ..znicz.nn_units import GradientDescentBase
+        gds = [u for u in self.workflow.units
+               if isinstance(u, GradientDescentBase)]
+        for name in self.names:
+            base = name[:-len("_bias")] if name.endswith("_bias") \
+                else name
+            consumers = [gd for gd in gds
+                         if base in gd.optimizer_obj.CONSUMED_HYPERS]
+            if not consumers:
                 raise Bug(
-                    "tuning gradient_moment requires momentum slots: "
-                    "give the GD units a nonzero baseline "
-                    "gradient_moment so velocities are allocated")
+                    "tuning %s but no GD unit's optimizer consumes "
+                    "it (optimizers in this workflow: %s) — tune a "
+                    "hyperparameter the configured optimizer reads"
+                    % (name, ", ".join(sorted(
+                        {gd.optimizer for gd in gds}) or ["none"])))
+            for gd in consumers:
+                prefix = gd.optimizer_obj.SLOT_BACKED_HYPERS.get(
+                    base)
+                if prefix and not any(
+                        s.startswith(prefix) for s in gd.tstate):
+                    raise Bug(
+                        "tuning gradient_moment requires momentum "
+                        "slots: give the GD units a nonzero baseline "
+                        "gradient_moment so velocities are allocated"
+                        if prefix == "velocity_" else
+                        "tuning %s requires %s* slots on %s, which "
+                        "were never allocated" %
+                        (name, prefix, gd.name))
 
     def evaluate(self, genes_matrix, epochs=None):
         """Trains every chromosome for ``epochs`` full epochs; returns

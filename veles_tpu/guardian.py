@@ -77,8 +77,10 @@ def restore_vectors(dst_workflow, src_workflow):
     (``Vector.mem``), so the next fused dispatch re-uploads under
     whatever sharding the live run uses."""
     from .memory import Vector
+    from .znicz.optimizers import param_of_slot
     src_units = {u.name: u for u in src_workflow.units}
     restored = 0
+    orphan_slots = []
     for unit in dst_workflow.units:
         src = src_units.get(unit.name)
         if src is None:
@@ -89,6 +91,16 @@ def restore_vectors(dst_workflow, src_workflow):
             if not isinstance(dst_vecs, dict) or \
                     not isinstance(src_vecs, dict):
                 continue
+            if which == "tstate":
+                # Optimizer slots pair by attr like everything else
+                # (velocity_*/adam_*/lion_* all ride tstate), but a
+                # snapshot trained under a DIFFERENT optimizer has no
+                # matching names — that must be loud, not a silent
+                # partial restore.
+                orphan_slots.extend(
+                    "%s/%s" % (unit.name, attr)
+                    for attr in src_vecs
+                    if param_of_slot(attr) and attr not in dst_vecs)
             for attr, dvec in dst_vecs.items():
                 svec = src_vecs.get(attr)
                 if not isinstance(dvec, Vector) or \
@@ -99,6 +111,12 @@ def restore_vectors(dst_workflow, src_workflow):
                 svec.map_read()
                 dvec.mem = numpy.array(svec.mem)
                 restored += 1
+    if orphan_slots:
+        dst_workflow.warning(
+            "rollback source holds optimizer slots the live run has "
+            "no home for (%s, ...) — it was trained under a "
+            "different optimizer; its weights restored but the live "
+            "optimizer state was NOT reset", orphan_slots[0])
     return restored
 
 

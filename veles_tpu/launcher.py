@@ -204,12 +204,24 @@ class Launcher(Logger):
                 # runs the same program; XLA's psum rides the
                 # cross-process collective backend).
                 import jax
-                from .parallel import make_mesh, apply_dp_sharding
+                from .parallel import (make_mesh, apply_dp_sharding,
+                                       apply_zero_sharding)
                 apply_dp_sharding(self.workflow,
                                   make_mesh(jax.devices()))
+                zero = int(config_get(root.common.engine.zero, 0)
+                           or 0)
+                if zero:
+                    # --zero: optimizer slots shard 1/dp over the
+                    # data axis (level 2 adds the grad reduce-scatter
+                    # constraints) — docs/optimizers.md.
+                    apply_zero_sharding(self.workflow,
+                                        self.workflow.mesh,
+                                        level=zero)
                 self.info("distributed SPMD: %d processes, %d "
-                          "devices", self.num_processes,
-                          len(jax.devices()))
+                          "devices%s", self.num_processes,
+                          len(jax.devices()),
+                          ", ZeRO-%d optimizer sharding" % zero
+                          if zero else "")
             else:
                 self.warning(
                     "distributed mode requested but %s has no fused-"

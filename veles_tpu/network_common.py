@@ -617,6 +617,16 @@ def init_parser(parser):
              "worker runs K ticks as one fused scan-block dispatch, "
              "amortizing one weight sync over K minibatches")
     parser.add_argument(
+        "--net-zero", type=int, default=None, metavar="K",
+        help="ZeRO over the wire: optimizer slots join the delta "
+             "data plane SHARDED K ways — each worker owns and syncs "
+             "a 1/K flat slice of every slot tensor, so slot wire "
+             "bytes and the master's per-worker synced-base memory "
+             "divide by K instead of replicating (default 0 = slots "
+             "stay worker-local; K=1 replicates the full state to "
+             "every worker; handshake-negotiated, old peers fall "
+             "back to no slot sync)")
+    parser.add_argument(
         "--net-legacy", action="store_true",
         help="force the legacy full-pickled-weights protocol "
              "(disables delta sync and tensor framing)")

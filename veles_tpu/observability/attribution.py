@@ -59,6 +59,10 @@ _state = {
     "device_s_total": 0.0,
 }
 _xprof = {"dir": None, "steps": 0, "done": 0, "started": False}
+#: Optimizer observability (StepCompiler.compile publishes once per
+#: compile): the configured kind(s), total slot bytes, and the ZeRO
+#: shard fraction each dp rank persistently stores (1.0 = replicated).
+_optimizer = {"kind": None, "state_bytes": None, "shard_frac": None}
 _timer = time.perf_counter  # injectable for tests
 #: configured-peak-value -> resolved FLOP/s (the device probe and
 #: config walk are constant per process; never pay them per
@@ -86,10 +90,13 @@ def reset():
     with _lock:
         _state.update(device_ms=None, mfu=None, flops=None,
                       dispatches=0, ticks=0, device_s_total=0.0)
+        _optimizer.update(kind=None, state_bytes=None,
+                          shard_frac=None)
     _xprof.update(dir=None, steps=0, done=0, started=False)
     _peak_cache.clear()
     from . import metrics
     metrics.registry.remove_prefix("device.")
+    metrics.registry.remove_prefix("optimizer.")
 
 
 def peak_flops():
@@ -242,6 +249,34 @@ def record_step(device_seconds, flops=None, ticks=1):
     return snap
 
 
+def note_optimizer(kind, state_bytes, shard_frac=1.0):
+    """Publishes the optimizer observability gauges (called by
+    ``StepCompiler.compile`` once per compile): ``optimizer.
+    state_bytes`` and ``optimizer.shard_frac`` in the process metrics
+    registry, labeled with the optimizer kind, plus the heartbeat
+    ``perf`` section fields (→ web_status perf row, /metrics)."""
+    with _lock:
+        _optimizer.update(kind=str(kind),
+                          state_bytes=int(state_bytes),
+                          shard_frac=float(shard_frac))
+    from . import metrics
+    reg = metrics.registry
+    labels = {"kind": str(kind)}
+    reg.gauge("optimizer.state_bytes",
+              labels=labels).set(int(state_bytes))
+    reg.gauge("optimizer.shard_frac",
+              labels=labels).set(round(float(shard_frac), 6))
+
+
+def optimizer_summary():
+    """The last published optimizer stats, or None before the first
+    compiled step."""
+    with _lock:
+        if _optimizer["kind"] is None:
+            return None
+        return dict(_optimizer)
+
+
 def estimate_flops(jitted, *args):
     """Per-dispatch FLOP count from XLA's HLO cost analysis of the
     jitted step (``Lowered.cost_analysis()`` — a re-trace, NOT a
@@ -274,4 +309,8 @@ def perf_summary():
             out["mfu"] = round(_state["mfu"], 4)
         if _state["flops"] is not None:
             out["flops_per_dispatch"] = _state["flops"]
+        if _optimizer["kind"] is not None:
+            out["optimizer"] = _optimizer["kind"]
+            out["optimizer_state_bytes"] = _optimizer["state_bytes"]
+            out["optimizer_shard_frac"] = _optimizer["shard_frac"]
     return out

@@ -235,13 +235,15 @@ def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
 
     def shard_slots_by_name(unit, gd):
         """Optimizer slots mirror their parameter BY NAME
-        (velocity_<param>) — shape matching alone could collide
-        (e.g. wq/wk/wv are all (E, E))."""
+        (velocity_<param>, adam_m_<param>, … — any registered prefix,
+        znicz.optimizers.param_of_slot) — shape matching alone could
+        collide (e.g. wq/wk/wv are all (E, E)).  Non-mirror slots
+        (Adam's scalar step counters) stay replicated."""
         if gd is None:
             return
+        from ..znicz.optimizers import param_of_slot
         for name, vec in gd.tstate.items():
-            pname = name[len("velocity_"):] \
-                if name.startswith("velocity_") else name
+            pname = param_of_slot(name) or name
             target = unit.trainables.get(pname)
             if vec and target is not None and \
                     tuple(vec.shape) == tuple(target.shape):
@@ -392,13 +394,13 @@ def apply_dp_ep_sharding(workflow, mesh, data_axis="data",
         sharded_blocks += 1
         gd = gd_of.get(unit)
         if gd is not None:
-            # Optimizer slots match their parameter BY NAME
-            # (velocity_<param>) — shape matching would mis-shard
-            # e.g. velocity_router when router (D, E) happens to
-            # collide with b2 (E, D).
+            # Optimizer slots match their parameter BY NAME (any
+            # registered slot prefix — velocity_/adam_m_/…) — shape
+            # matching would mis-shard e.g. velocity_router when
+            # router (D, E) happens to collide with b2 (E, D).
+            from ..znicz.optimizers import param_of_slot
             for name, vec in gd.tstate.items():
-                pname = name[len("velocity_"):] \
-                    if name.startswith("velocity_") else name
+                pname = param_of_slot(name) or name
                 target = expert_params.get(pname)
                 if vec and target is not None and \
                         tuple(vec.shape) == tuple(target.shape):
@@ -445,11 +447,11 @@ def apply_dp_pp_sharding(workflow, mesh, data_axis="data",
         sharded_stacks += 1
         gd = gd_of.get(unit)
         if gd is not None:
-            # By-name slot matching (velocity_<param>), as in the
-            # expert helper.
+            # By-name slot matching (any registered slot prefix), as
+            # in the expert helper.
+            from ..znicz.optimizers import param_of_slot
             for name, vec in gd.tstate.items():
-                pname = name[len("velocity_"):] \
-                    if name.startswith("velocity_") else name
+                pname = param_of_slot(name) or name
                 target = stage_params.get(pname)
                 if vec and target is not None and \
                         tuple(vec.shape) == tuple(target.shape):
@@ -460,6 +462,98 @@ def apply_dp_pp_sharding(workflow, mesh, data_axis="data",
             "divides the stage axis (%d) — the workflow runs "
             "data-parallel only" % n_stage)
     workflow._parallel_style_ = ("dp_pp", data_axis, stage_axis)
+    return workflow
+
+
+def apply_zero_sharding(workflow, mesh=None, data_axis="data",
+                        level=1):
+    """ZeRO-1/2 optimizer-state sharding over the ``data`` axis —
+    call AFTER one of the ``apply_*_sharding`` appliers (it composes
+    with all of them).
+
+    * **Level 1** re-annotates every GD unit's optimizer slot whose
+      leading dimension divides the data-axis size: dim 0 gains the
+      ``data`` axis ON TOP of whatever model/expert/stage axes the
+      style applier put on the other dims, so each dp rank
+      persistently stores 1/dp of the optimizer state in HBM.  XLA's
+      sharding propagation then computes the slot update shard-local
+      and all-gathers the parameter delta — the ZeRO-1 dataflow
+      (update your shard, all-gather params) expressed as GSPMD
+      annotations instead of hand-written ``shard_map``/
+      ``psum_scatter`` collectives (same collectives on the wire,
+      zero bespoke step code, and it composes with dp×tp for free).
+    * **Level 2** additionally records a sharding constraint for each
+      slot-backed gradient (consumed by ``StepCompiler``'s
+      ``apply_updates``), so the gradient all-reduce over ``data``
+      lowers to a reduce-scatter feeding the sharded update instead
+      of a full all-reduce followed by a slice — the ZeRO-2
+      grad-shard variant.
+
+    Slots whose geometry does not divide the axis — or whose dim 0
+    is already owned by an expert/stage axis — stay as the style
+    applier left them (correct, merely not ZeRO-sharded); scalar
+    slots (Adam's step counters) always stay replicated.
+
+    Numerics: allclose, not bit-identical — collective reduction
+    orders move; ``dryrun_multichip`` self-verifies sharded ==
+    1-device under the usual per-precision tolerances.
+
+    Snapshots are UNAFFECTED in shape: Vector pickling gathers the
+    full host value regardless of layout, so a ZeRO snapshot restores
+    at any dp (re-shard on resume = re-run the appliers + this).
+    """
+    from ..znicz.nn_units import GradientDescentBase
+    from ..znicz.optimizers import param_of_slot
+    if mesh is None:
+        mesh = getattr(workflow, "mesh", None)
+    if mesh is None or data_axis not in mesh.shape:
+        raise ValueError(
+            "apply_zero_sharding needs a mesh carrying axis %r — "
+            "apply a dp/dp×tp/... sharding first" % data_axis)
+    dp = mesh.shape[data_axis]
+    grad_specs = {}
+    compiler = workflow.compiler
+    compiler.analyze()
+    sharded = 0
+    for gd in [u for u in workflow.units
+               if isinstance(u, GradientDescentBase)]:
+        target = getattr(gd, "target", None)
+        for name, vec in gd.tstate.items():
+            if not vec or not vec.shape or len(vec.shape) < 1:
+                continue  # scalar slots stay replicated
+            if dp <= 1 or vec.shape[0] % dp:
+                continue
+            cur = ()
+            if isinstance(vec.sharding, NamedSharding):
+                cur = tuple(vec.sharding.spec)
+            axes = list(cur) + [None] * (len(vec.shape) - len(cur))
+            if axes[0] is not None:
+                continue  # dim 0 already owned (expert/stage axis)
+            axes[0] = data_axis
+            spec = NamedSharding(mesh, PartitionSpec(*axes))
+            vec.sharding = spec
+            sharded += 1
+            if level >= 2 and target is not None:
+                pattr = param_of_slot(name)
+                pvec = target.trainables.get(pattr) if pattr else None
+                if pvec is not None and \
+                        tuple(pvec.shape) == tuple(vec.shape):
+                    grad_specs[compiler.param_name(target, pattr)] = \
+                        spec
+    if sharded == 0:
+        workflow.warning(
+            "apply_zero_sharding: no optimizer slot's leading "
+            "dimension divides the data axis (%d) — optimizer state "
+            "stays replicated" % dp)
+    workflow._zero_grad_shardings_ = grad_specs
+    # The recorded dp feeds the optimizer.shard_frac gauge: when
+    # nothing sharded, each rank still stores the FULL state — the
+    # gauge must say 1.0, not 1/dp (level is kept so rebuild_mesh
+    # retries ZeRO over whatever mesh the survivors form).
+    workflow._zero_ = (level, dp if sharded else 1, data_axis)
+    # The compiled step (and its captured grad constraints)
+    # specialized on the old layout.
+    compiler._compiled = None
     return workflow
 
 
@@ -598,6 +692,16 @@ def rebuild_mesh(workflow, surviving_devices=None, axis="data",
                     % (n, style[0]))
             mesh = make_mesh(surviving_devices, {axis: n})
             apply_dp_sharding(workflow, mesh, axis=axis)
+        # ZeRO re-applies over the shrunk mesh (the style appliers
+        # just reset every slot to its non-ZeRO layout); the data
+        # axis may now be a different size — slots re-shard 1/dp'.
+        zero = getattr(workflow, "_zero_", None)
+        if zero:
+            level, _old_dp, zaxis = zero
+            apply_zero_sharding(
+                workflow, mesh,
+                data_axis=zaxis if zaxis in mesh.shape else axis,
+                level=level)
     # The jitted step specialized on the old device set/shardings.
     workflow.compiler._compiled = False
     loader = getattr(workflow, "loader", None)

@@ -59,6 +59,7 @@ def negotiate_protocol(hello, cfg=None):
                 root.common.net.codec_threshold, 1 << 16),
             "dtype": config_get(root.common.net.dtype, "fp32"),
             "job_ticks": config_get(root.common.net.job_ticks, 1),
+            "zero": config_get(root.common.net.zero, 0),
             "require": config_get(root.common.net.require, False),
             # None = derive from the live tracing state (--trace-out
             # flips it on); an explicit config value wins.
@@ -94,6 +95,15 @@ def negotiate_protocol(hello, cfg=None):
         "dtype": dtype,
         "ticks": max(1, ticks),
     }
+    # ZeRO slot-shard sync (--net-zero K; docs/distributed.md):
+    # optimizer slots join the delta data plane, sharded K ways —
+    # each worker syncs only its 1/K flat slice.  Needs the delta
+    # dialect AND the worker's "slots" capability; old peers never
+    # see the key (protocol version bump by capability, not by
+    # breaking the frame format).
+    zero = int(cfg.get("zero") or 0)
+    if zero > 0 and proto["delta"] and theirs.get("slots"):
+        proto["zero"] = zero
     # Span tracing (docs/observability.md): when the master traces
     # and the worker advertises the capability, job frames carry
     # clock-sync timestamps + trace context and updates carry the
@@ -124,6 +134,9 @@ class SlaveDescription(object):
         self.last_update = None
         self.blacklisted = False
         self.paused = False
+        #: Slot-shard rank this session owns (--net-zero sessions
+        #: only) — consulted when assigning ranks to later joiners.
+        self.zero_rank = None
         #: Parole: this session belongs to a previously-blacklisted
         #: machine — it gets ONE job at a time until one completes
         #: clean (then the machine's blacklist entry is erased).
@@ -171,6 +184,8 @@ class Server(Logger):
         self._retired_slaves = {}
         self._max_retired = int(kwargs.get("max_retired", 64))
         self._slave_seq = 0
+        #: Round-robin shard-rank assignment for --net-zero sessions.
+        self._zero_seq = 0
         self._stop = threading.Event()
         self.on_stopped = kwargs.get("on_stopped")
         #: Frames are HMAC-authenticated before unpickling.  Key
@@ -412,9 +427,29 @@ class Server(Logger):
                 self._slave_seq += 1
                 sid = "%s/%d" % (hello.get("mid", machine_id()),
                                  self._slave_seq)
+                if proto.get("zero"):
+                    # Slot-shard ownership: the lowest shard rank no
+                    # LIVE session holds, so churn re-fills orphaned
+                    # shards instead of blindly round-robining past
+                    # them (a replacement for a dead rank-1 worker
+                    # must own shard 1, not double up on 0).  With
+                    # more workers than shards, overlap resolves
+                    # last-writer-wins at the fold — degraded
+                    # freshness, never corruption.
+                    proto = dict(proto)
+                    k = int(proto["zero"])
+                    held = {s.zero_rank for s in
+                            self._slaves.values()
+                            if s.zero_rank is not None}
+                    free = [r for r in range(k) if r not in held]
+                    proto["zero_rank"] = free[0] if free else \
+                        self._zero_seq % k
+                    self._zero_seq += 1
                 desc = SlaveDescription(
                     sid, hello.get("mid"), hello.get("power", 1.0),
                     addr)
+                desc.zero_rank = proto.get("zero_rank") \
+                    if proto else None
                 if desc.mid in self._blacklist:
                     # Parole: the machine was blacklisted — it may
                     # rejoin, but on probation (no jobs until the

@@ -382,6 +382,17 @@ class SnapshotterBase(Unit, metaclass=SnapshotterRegistry):
                 out["validation_error"] = float(verr)
         except (TypeError, ValueError):
             pass
+        # Optimizer kind(s): resuming under a DIFFERENT optimizer
+        # fails at initialize with a slot-mismatch error — recording
+        # the kind here lets operators (and tooling) see what a
+        # checkpoint needs before loading multi-GB state.
+        kinds = sorted({
+            kind for kind in (
+                getattr(unit, "optimizer", None)
+                for unit in getattr(self.workflow, "units", ()))
+            if isinstance(kind, str)})
+        if kinds:
+            out["optimizer"] = "+".join(kinds)
         return out
 
     def export(self):

@@ -18,8 +18,10 @@ import numpy
 
 from .. import prng
 from ..accelerated_units import TracedUnit
+from ..config import root, get as config_get
 from ..memory import Vector
 from ..registry import MappedUnitRegistry
+from . import optimizers
 
 
 # -- shared activation bodies (one definition for the all2all / conv /
@@ -49,6 +51,18 @@ def act_strict_relu(v):
 def act_sigmoid(v):
     import jax
     return jax.nn.sigmoid(v)
+
+
+def _proto_of_slave(unit, slave):
+    """The negotiated wire protocol for one worker session ({} =
+    legacy) — shared by every unit participating in the data plane."""
+    get = getattr(unit.workflow, "slave_protocol", None)
+    return get(slave) if get is not None else {}
+
+
+def _proto_of_net(unit):
+    """This worker session's negotiated protocol ({} = legacy)."""
+    return getattr(unit.workflow, "net_proto", None) or {}
 
 
 class ForwardUnitRegistry(MappedUnitRegistry):
@@ -152,11 +166,10 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
         return out
 
     def _slave_proto(self, slave):
-        get = getattr(self.workflow, "slave_protocol", None)
-        return get(slave) if get is not None else {}
+        return _proto_of_slave(self, slave)
 
     def _net_proto(self):
-        return getattr(self.workflow, "net_proto", None) or {}
+        return _proto_of_net(self)
 
     @staticmethod
     def _as_bits(arr):
@@ -322,9 +335,13 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
 class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
     """Per-layer trainer (znicz ``GradientDescentBase`` analogue).
 
-    Holds the update hyperparameters and momentum slots for its
+    Holds the update hyperparameters and optimizer slots for its
     ``target`` forward unit; ``tupdate`` is called inside the fused
-    step with the autodiff gradient.
+    step with the autodiff gradient and delegates to the registered
+    optimizer's pure update rule (``optimizers.py`` — sgd is the
+    bit-identical default; adam/adamw/lion declare their own slots,
+    which flow through sharding plans, snapshots and rollback exactly
+    like the historic ``velocity_*`` momentum did).
     """
 
     hide_from_registry = True
@@ -333,6 +350,19 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
         super(GradientDescentBase, self).__init__(workflow, **kwargs)
         self.view_group = "TRAINER"
         self.target = kwargs.get("target")
+        #: Update rule (optimizers registry).  Explicit kwarg pins it
+        #: against the ``--optimizer`` config override; the override
+        #: otherwise applies at initialize (so a RESUMED unit meets
+        #: the slot-mismatch check instead of silently reinit'ing).
+        self.optimizer = kwargs.get("optimizer") or config_get(
+            root.common.engine.optimizer, "sgd")
+        self._optimizer_explicit = "optimizer" in kwargs
+        optimizers.get(self.optimizer)  # validate early, actionably
+        #: Adam/Lion moment coefficients + epsilon; None = the
+        #: optimizer's own default (HYPER_DEFAULTS).
+        self.beta1 = kwargs.get("beta1")
+        self.beta2 = kwargs.get("beta2")
+        self.eps = kwargs.get("eps")
         self.learning_rate = kwargs.get("learning_rate", 0.01)
         self.learning_rate_bias = kwargs.get(
             "learning_rate_bias", self.learning_rate)
@@ -356,6 +386,13 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
 
     def init_unpickled(self):
         super(GradientDescentBase, self).init_unpickled()
+        # Slot-shard wire sync (docs/distributed.md ZeRO section):
+        # transient per-session state, mirroring ForwardBase's delta
+        # bookkeeping — master: slave -> (version, shard arrays);
+        # worker: last-synced shard arrays + version.
+        self._slot_synced_ = {}
+        self._slot_base_ = None
+        self._slot_base_version_ = None
         # A snapshot from before the structural flag existed carries
         # no _bias_tied: reconstruct it from value equality (the old
         # semantics) so a restored population keeps tying the way it
@@ -369,6 +406,14 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
                 "gradient_moment":
                     self.gradient_moment_bias == self.gradient_moment,
             }
+        # Pre-registry snapshots carry no optimizer attrs: they were
+        # trained by the inline momentum-SGD rule, which "sgd"
+        # reproduces bit-identically.
+        if not hasattr(self, "optimizer") and \
+                hasattr(self, "learning_rate"):
+            self.optimizer = "sgd"
+            self._optimizer_explicit = False
+            self.beta1 = self.beta2 = self.eps = None
 
     def link_target(self, target):
         self.target = target
@@ -377,6 +422,11 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
     @property
     def tstate(self):
         return dict(self._velocities)
+
+    @property
+    def optimizer_obj(self):
+        """The registered optimizer implementing this unit's rule."""
+        return optimizers.get(self.optimizer)
 
     def initialize(self, device=None, **kwargs):
         super(GradientDescentBase, self).initialize(
@@ -389,9 +439,34 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
             raise AttributeError(
                 "%s: target %s not initialized yet" %
                 (self.name, self.target.name))
-        if self.gradient_moment or self.gradient_moment_bias:
-            for attr, vec in self.target.trainables.items():
-                slot = "velocity_" + attr
+        # CLI/config override (--optimizer): applies only to units
+        # that did not pin a rule explicitly — on a resumed snapshot
+        # this is what routes a changed optimizer into the
+        # slot-mismatch check below.
+        override = config_get(root.common.engine.optimizer, None)
+        if override and not getattr(self, "_optimizer_explicit",
+                                    False):
+            optimizers.get(override)
+            self.optimizer = override
+        opt = self.optimizer_obj
+        stale = sorted(
+            s for s in self._velocities
+            if not any(s.startswith(p) for p in opt.SLOT_PREFIXES))
+        if stale:
+            # A momentum snapshot resumed into an Adam run (or any
+            # other optimizer switch): silently reinitializing the
+            # slots would discard the optimizer state the snapshot
+            # carried — fail with the fix spelled out.
+            raise optimizers.SlotMismatchError(
+                "%s holds optimizer slots %s that do not belong to "
+                "optimizer %r (its slot prefixes: %s) — the snapshot "
+                "was trained with a different optimizer; resume with "
+                "the matching --optimizer, or clear the unit's slots "
+                "to start optimizer state fresh"
+                % (self.name, stale, self.optimizer,
+                   ", ".join(opt.SLOT_PREFIXES) or "none"))
+        for attr, vec in self.target.trainables.items():
+            for slot, shape, dtype in opt.slots(attr, vec, self):
                 if slot not in self._velocities:
                     # Host-zeros init, uploaded lazily.  Creating the
                     # zeros ON DEVICE (jnp.zeros, jitted or eager)
@@ -402,7 +477,7 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
                     # params-sized transfer, relocated INTO the
                     # measured window (a 10× apparent bench
                     # regression; see BENCHNOTES.md).
-                    v = Vector(numpy.zeros(vec.shape, dtype=vec.dtype))
+                    v = Vector(numpy.zeros(shape, dtype=dtype))
                     v.initialize(self.device)
                     self._velocities[slot] = v
 
@@ -441,36 +516,324 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
                 out.append(hypers.get(name, own_v))
         return tuple(out)
 
+    def _hyper_dict(self, attr, hypers=None):
+        """The full hyperparameter dict handed to the optimizer's
+        update rule: the classic lr/decay/moment triple (bias-aware,
+        see :meth:`_hyper`) plus the optimizer's extra hypers
+        (beta1/beta2/eps), each overridable by a traced scalar from
+        ``hypers`` (the vmapped population path)."""
+        lr, decay, moment = self._hyper(attr, hypers)
+        out = {"learning_rate": lr, "weights_decay": decay,
+               "gradient_moment": moment}
+        defaults = self.optimizer_obj.HYPER_DEFAULTS
+        for name in ("beta1", "beta2", "eps"):
+            if hypers and name in hypers:
+                out[name] = hypers[name]
+                continue
+            own = getattr(self, name, None)
+            out[name] = defaults.get(name) if own is None else own
+        return out
+
     def tupdate(self, attr, param, grad, state, ctx, hypers=None):
-        """Classic momentum SGD with L2 decay (AlexNet-era rule used by
-        znicz GD units): v ← μv − lr·(g + λp); p ← p + v.
+        """Applies this unit's optimizer rule (``optimizers.py``;
+        sgd = the classic momentum-SGD-with-L2 znicz rule,
+        bit-identical to the pre-registry inline code).
 
         ``hypers`` optionally overrides the Python-float
         hyperparameters with traced scalars (the vmapped population
         path evaluates every chromosome in one compiled program, so
         its hypers must be step *inputs*, not baked constants)."""
-        lr, decay, moment = self._hyper(attr, hypers)
-        slot = "velocity_" + attr
-        new_state = {}
-        if hypers:
-            # Traced values: no Python truth tests; the momentum
-            # branch is decided by the (static) presence of the slot.
-            g = grad + decay * param
-            if slot in state:
-                v = moment * state[slot] - lr * g
-                new_param = param + v
-                new_state[slot] = v
+        return self.optimizer_obj.update(
+            attr, param, grad, state, self._hyper_dict(attr, hypers),
+            traced=bool(hypers))
+
+    # -- slot-shard wire sync (ZeRO over the delta data plane) -------------
+    #
+    # Opt-in (``--net-zero K``, handshake-negotiated as proto
+    # ``zero``/``zero_rank``): optimizer slots join the master–slave
+    # delta protocol, but SHARDED — each worker syncs only its
+    # 1/dp flat slice of every slot tensor, so per-minibatch slot
+    # wire bytes and the master's per-worker synced-base bookkeeping
+    # both divide by dp instead of replicating (docs/distributed.md).
+    # The machinery mirrors ForwardBase's trainable sync exactly:
+    # master→worker full-ship at join then XOR deltas tagged with
+    # weights_version, worker→master arithmetic deltas folded as
+    # ``shard += delta``, unchanged tensors collapsing to None.
+    # Default (zero absent) ships NOTHING — today's behavior, where
+    # worker optimizer state is purely local.
+
+    def _zero_shard(self, proto):
+        """(rank, dp) for a negotiated slot-sync session, else None
+        (no slot shipping).  Requires the delta dialect: shard folds
+        lean on the same synced-base discipline."""
+        dp = int(proto.get("zero") or 0)
+        if dp <= 0 or not proto.get("delta"):
+            return None
+        return int(proto.get("zero_rank") or 0), dp
+
+    @staticmethod
+    def _shard_bounds(vec, rank, dp):
+        """Flat [lo, hi) slice of ``vec`` owned by ``rank`` (the last
+        rank absorbs the remainder; scalars land on the last rank)."""
+        n = vec.size
+        return rank * n // dp, (rank + 1) * n // dp
+
+    def _slot_shard_arrays(self, rank, dp):
+        out = {}
+        for slot, vec in self.tstate.items():
+            lo, hi = self._shard_bounds(vec, rank, dp)
+            if hi <= lo:
+                continue
+            vec.map_read()
+            out[slot] = numpy.array(vec.mem.reshape(-1)[lo:hi])
+        return out
+
+    def _check_shard(self, slot, size, rank, dp):
+        """Raises ProtocolError unless ``slot`` exists here and rank
+        owns exactly ``size`` of its elements — called on EVERY shard
+        of a message before any of them mutates local state, so a bad
+        frame never leaves a half-applied base behind."""
+        from ..resilience import ProtocolError
+        vec = self.tstate.get(slot)
+        if vec is None:
+            raise ProtocolError(
+                "slot sync names unknown optimizer slot %r on %s"
+                % (slot, self.name))
+        lo, hi = self._shard_bounds(vec, rank, dp)
+        if hi - lo != size:
+            raise ProtocolError(
+                "slot shard for %s/%s is %d elements but rank %d/%d "
+                "owns %d — shard geometry desync" %
+                (self.name, slot, size, rank, dp, hi - lo))
+
+    def _store_shard(self, slot, arr, rank, dp):
+        vec = self.tstate[slot]
+        lo, hi = self._shard_bounds(vec, rank, dp)
+        vec.map_write()
+        vec.mem.reshape(-1)[lo:hi] = arr
+
+    def generate_data_for_slave(self, slave=None):
+        """Master side: ships this worker's slot SHARD — full at
+        join/rebase, XOR delta after (same dialect as ForwardBase
+        trainables; unchanged slots collapse to None)."""
+        proto = self._slave_proto(slave)
+        shard = self._zero_shard(proto)
+        if shard is None or not self.tstate:
+            return None
+        rank, dp = shard
+        arrays = self._slot_shard_arrays(rank, dp)
+        if not arrays:
+            return None
+        from .. import resilience
+        version = getattr(self.workflow, "weights_version", 0)
+        prev = self._slot_synced_.get(slave)
+        self._slot_synced_[slave] = (version, arrays)
+        if prev is None:
+            resilience.stats.incr(
+                "net.slot_bytes",
+                sum(a.nbytes for a in arrays.values()))
+            return {"F": arrays, "v": version}
+        base_version, base = prev
+        delta = {}
+        sent = 0
+        for slot, arr in arrays.items():
+            b = base.get(slot)
+            if b is None or b.shape != arr.shape or \
+                    b.dtype != arr.dtype:
+                # Mid-session rebase ships the full shard — counted
+                # like the join-time ship above.
+                resilience.stats.incr(
+                    "net.slot_bytes",
+                    sum(a.nbytes for a in arrays.values()))
+                return {"F": arrays, "v": version}
+            bits = numpy.bitwise_xor(ForwardBase._as_bits(arr),
+                                     ForwardBase._as_bits(b))
+            if bits.any():
+                delta[slot] = bits
+                sent += bits.nbytes
             else:
-                new_param = param - lr * g
-            return new_param, new_state
-        g = grad + decay * param if decay else grad
-        if moment and slot in state:
-            v = moment * state[slot] - lr * g
-            new_param = param + v
-            new_state[slot] = v
-        else:
-            new_param = param - lr * g
-        return new_param, new_state
+                delta[slot] = None
+        resilience.stats.incr("net.slot_bytes", sent)
+        return {"D": delta, "v": version, "bv": base_version}
+
+    def apply_data_from_master(self, data):
+        """Worker side: lands the master's slot shard into the local
+        slot Vectors (the rest of each tensor stays this worker's own
+        state, exactly as all of it did before slot sync existed)."""
+        if not data:
+            return
+        from ..resilience import ProtocolError
+        shard = self._zero_shard(self._net_proto())
+        if shard is None:
+            return
+        rank, dp = shard
+        if "F" in data:
+            # Validate EVERY shard before mutating anything: a bad
+            # frame must not leave a partially-populated base (a
+            # non-None partial base would later ship a bogus full
+            # rebase instead of triggering the reconnect recovery).
+            for slot, arr in data["F"].items():
+                self._check_shard(slot, arr.size, rank, dp)
+            base = {}
+            for slot, arr in data["F"].items():
+                self._store_shard(slot, arr, rank, dp)
+                base[slot] = numpy.array(arr)
+            self._slot_base_ = base
+            self._slot_base_version_ = data.get("v")
+            return
+        if "D" not in data:
+            return
+        if self._slot_base_ is None:
+            raise ProtocolError(
+                "slot-shard delta received before any full sync — "
+                "the session is desynchronized; reconnecting will "
+                "trigger a full rebase")
+        if data.get("bv") != self._slot_base_version_:
+            raise ProtocolError(
+                "slot-shard delta based on version %s but this "
+                "worker is synced to %s — reconnecting will trigger "
+                "a full rebase" % (data.get("bv"),
+                                   self._slot_base_version_))
+        updates = {}  # validate-then-commit, like the "F" branch
+        for slot, bits in data["D"].items():
+            base = self._slot_base_.get(slot)
+            if base is None:
+                raise ProtocolError(
+                    "slot-shard delta names unsynced slot %r" % slot)
+            if bits is None:  # unchanged since last sync
+                updates[slot] = (base, False)
+                continue
+            self._check_shard(slot, base.size, rank, dp)
+            if bits.size != base.size:
+                raise ProtocolError(
+                    "slot-shard delta for %r is %d elements against "
+                    "a %d-element base — shard geometry desync"
+                    % (slot, bits.size, base.size))
+            new = numpy.bitwise_xor(
+                ForwardBase._as_bits(base),
+                bits.reshape(base.shape)).view(base.dtype)
+            updates[slot] = (new, True)
+        for slot, (new, changed) in updates.items():
+            if changed:
+                self._slot_base_[slot] = new
+            self._store_shard(slot, numpy.array(new), rank, dp)
+        self._slot_base_version_ = data.get("v")
+
+    def generate_data_for_master(self):
+        """Worker side: BITWISE XOR deltas of this worker's slot
+        shard against its synced base — the master reconstructs the
+        worker's exact values (xor is exact, unlike an arithmetic
+        ``base + (theirs − base)`` fold, which can drift a ulp), so
+        the canonical optimizer state the master snapshots is
+        bit-identical to what the trainer computed.  Untouched slots
+        collapse to None markers; the base advances to what was just
+        shipped, so the master→worker direction zero-collapses in
+        steady state too.  No bf16 option here: exact reconstruction
+        is the whole point (same stance as the master→worker weights
+        XOR path)."""
+        proto = self._net_proto()
+        shard = self._zero_shard(proto)
+        if shard is None or self._slot_base_ is None or \
+                not self.tstate:
+            return None
+        rank, dp = shard
+        arrays = self._slot_shard_arrays(rank, dp)
+        from .. import resilience
+        delta = {}
+        sent = 0
+        for slot, arr in arrays.items():
+            b = self._slot_base_.get(slot)
+            if b is None or b.shape != arr.shape or \
+                    b.dtype != arr.dtype:
+                # Desynced slot set: full shard rebase.
+                resilience.stats.incr(
+                    "net.slot_bytes",
+                    sum(a.nbytes for a in arrays.values()))
+                self._slot_base_ = {s: numpy.array(a)
+                                    for s, a in arrays.items()}
+                return {"S": arrays}
+            bits = numpy.bitwise_xor(ForwardBase._as_bits(arr),
+                                     ForwardBase._as_bits(b))
+            if bits.any():
+                delta[slot] = bits
+                sent += bits.nbytes
+                self._slot_base_[slot] = arr
+            else:
+                delta[slot] = None
+        resilience.stats.incr("net.slot_bytes", sent)
+        return {"X": delta}
+
+    def apply_data_from_slave(self, data, slave=None):
+        """Master side: reconstructs the owner's shard values from
+        the XOR delta against what this master last synced to that
+        worker (bit-exact; concurrent owners of one shard — dp=1
+        replication, or churn-induced overlap — resolve
+        last-writer-wins, which is the right semantics for optimizer
+        state: the owner's state IS canonical, unlike weight updates,
+        which must compose additively)."""
+        if not data:
+            return
+        shard = self._zero_shard(self._slave_proto(slave))
+        if shard is None:
+            return
+        rank, dp = shard
+        prev = self._slot_synced_.get(slave)
+        synced = prev[1] if prev else {}
+        if prev is None:
+            self._slot_synced_[slave] = (None, synced)
+        # Peer-supplied bytes NEVER raise here: a master-side
+        # exception while folding stops the whole coordinator
+        # (server._serve_slave's loud-stop contract is for MASTER
+        # faults) — a desynced/misconfigured worker's slot piece is
+        # dropped with a warning instead, exactly like the weight
+        # fold tolerates unknown attrs.  The worker's own training
+        # update still folded; only its slot mirror is skipped.
+        from .. import resilience
+        if "S" in data:  # full shard rebase from the worker
+            for slot, arr in data["S"].items():
+                try:
+                    self._check_shard(slot, arr.size, rank, dp)
+                except Exception as e:
+                    resilience.stats.incr("net.slot_dropped")
+                    self.warning("dropping slot rebase from %s: %s",
+                                 slave, e)
+                    continue
+                self._store_shard(slot, arr, rank, dp)
+                synced[slot] = numpy.array(arr)
+            return
+        if "X" not in data:
+            return
+        for slot, bits in data["X"].items():
+            if bits is None:  # unchanged
+                continue
+            base = synced.get(slot)
+            if base is None or base.size != bits.size:
+                resilience.stats.incr("net.slot_dropped")
+                self.warning(
+                    "slot-shard XOR delta for %s/%s has no matching "
+                    "synced base — dropped (worker %s will rebase "
+                    "on its next full sync)", self.name, slot, slave)
+                continue
+            new = numpy.bitwise_xor(ForwardBase._as_bits(base),
+                                    bits).view(base.dtype)
+            try:
+                self._check_shard(slot, new.size, rank, dp)
+            except Exception as e:
+                resilience.stats.incr("net.slot_dropped")
+                self.warning("dropping slot delta from %s: %s",
+                             slave, e)
+                continue
+            self._store_shard(slot, new, rank, dp)
+            synced[slot] = new
+
+    def drop_slave(self, slave=None):
+        self._slot_synced_.pop(slave, None)
+
+    def _slave_proto(self, slave):
+        return _proto_of_slave(self, slave)
+
+    def _net_proto(self):
+        return _proto_of_net(self)
 
     def tforward(self, read, write, params, ctx, state=None):
         """GD units contribute no forward compute."""

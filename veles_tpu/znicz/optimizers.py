@@ -1,0 +1,272 @@
+"""Pluggable optimizer registry for the fused training step.
+
+The reference platform hardcoded ONE update rule — classic momentum
+SGD with L2 decay (znicz ``GradientDescentBase``; here the rule lived
+inline in ``nn_units.GradientDescentBase.tupdate`` until ISSUE 9).
+This module extracts it into a registry of named optimizers so the
+same :class:`~veles_tpu.znicz.nn_units.GradientDescentBase` units —
+and therefore every workflow, sharding plan, snapshot and wire
+protocol built on them — carry Adam/AdamW/Lion without any change to
+the step compiler:
+
+* every optimizer declares its **slot names** (``velocity_<param>``,
+  ``adam_m_<param>``, …) and per-slot dtypes; slots are ordinary
+  ``tstate`` Vectors, so they follow their parameter BY NAME through
+  the TP/EP/PP sharding plans (``parallel/mesh.py``), ride snapshots
+  via the host mirror, and are restored by guardian rollback exactly
+  like momentum always was;
+* the update rule is a pure function
+  ``update(attr, param, grad, state, hyper, traced) ->
+  (new_param, new_slots)`` that ``StepCompiler`` flows generically
+  through ``execute``/``execute_block`` (single-tick, scan-block and
+  vmapped-population modes all reuse it);
+* hyperparameters are declared so the genetics vmapped evaluator can
+  turn them into traced step inputs (Adam betas/eps tune exactly like
+  the classic learning rate).
+
+The ``sgd`` entry is the bit-identical default: its ``update`` is the
+pre-registry code moved verbatim, its slots keep the historic
+``velocity_`` names and allocation condition, so every seeded
+trajectory (MNIST/tinylm/MoE recall gates) is unchanged.
+
+Slot naming contract (docs/optimizers.md): a slot name is
+``<prefix><param_attr>`` with the prefix unique per slot KIND across
+all registered optimizers — :func:`param_of_slot` inverts it, which
+is what the mesh sharding plans and ZeRO rely on.  Scalar slots
+(Adam's per-parameter step counter ``adam_t_``) are shape ``()`` and
+never sharded.
+"""
+
+import numpy
+
+#: name → Optimizer instance (singletons; optimizers are stateless).
+OPTIMIZERS = {}
+
+
+class SlotMismatchError(ValueError):
+    """Optimizer slots restored from a snapshot do not belong to the
+    optimizer this run is configured with (e.g. a momentum-SGD
+    snapshot resumed into an Adam run).  Raised at initialize with an
+    actionable message instead of silently reinitializing — silent
+    slot reinit would quietly discard the optimizer state the
+    snapshot carried."""
+
+
+def register(cls):
+    """Class decorator: instantiates and registers an optimizer under
+    its ``NAME``."""
+    OPTIMIZERS[cls.NAME] = cls()
+    return cls
+
+
+def get(name):
+    """The registered optimizer, or an actionable error naming the
+    known ones."""
+    try:
+        return OPTIMIZERS[name]
+    except KeyError:
+        raise ValueError(
+            "unknown optimizer %r (known: %s)"
+            % (name, ", ".join(sorted(OPTIMIZERS)))) from None
+
+
+def slot_prefixes():
+    """Every registered slot-name prefix (longest first, so
+    :func:`param_of_slot` never under-strips a prefix that contains
+    another)."""
+    out = set()
+    for opt in OPTIMIZERS.values():
+        out.update(opt.SLOT_PREFIXES)
+    return tuple(sorted(out, key=len, reverse=True))
+
+
+def param_of_slot(slot_name):
+    """The parameter attr a slot name mirrors (``adam_m_weights`` →
+    ``weights``), or None when ``slot_name`` carries no registered
+    prefix (it is then not an optimizer slot — e.g. an evaluator
+    accumulator)."""
+    for prefix in slot_prefixes():
+        if slot_name.startswith(prefix):
+            return slot_name[len(prefix):]
+    return None
+
+
+class Optimizer(object):
+    """One update rule + its slot/hyperparameter declarations."""
+
+    NAME = None
+    #: Slot-name prefixes this optimizer owns (unique per kind).
+    SLOT_PREFIXES = ()
+    #: Hyper leaf names beyond the classic lr/decay/moment set that
+    #: the vmapped GA path may turn into traced step inputs.
+    EXTRA_HYPERS = ()
+    #: Hyper names this rule actually reads (GA tuning a hyper no
+    #: unit's optimizer consumes is a config bug, caught loudly).
+    CONSUMED_HYPERS = ("learning_rate", "weights_decay")
+    #: hyper name → slot prefix that must be allocated for the hyper
+    #: to have any effect (vmap_eval refuses to tune it otherwise).
+    SLOT_BACKED_HYPERS = {}
+    #: Defaults for EXTRA_HYPERS when the GD unit does not set them.
+    HYPER_DEFAULTS = {}
+
+    def slots(self, attr, vec, gd):
+        """Slot declarations for parameter ``attr`` (its Vector
+        ``vec``) on GD unit ``gd``: yields ``(name, shape, dtype)``."""
+        return ()
+
+    def update(self, attr, param, grad, state, hyper, traced=False):
+        """Pure update rule: returns ``(new_param, new_slots)`` where
+        ``new_slots`` maps full slot names to their new values.
+        ``hyper`` is a dict (learning_rate/weights_decay/
+        gradient_moment/beta1/beta2/eps) of Python floats — or traced
+        scalars when ``traced`` (the vmapped population path), in
+        which case NO Python truth test may touch a hyper value."""
+        raise NotImplementedError()
+
+
+@register
+class SGD(Optimizer):
+    """Classic momentum SGD with L2 decay — the znicz AlexNet-era
+    rule: v ← μv − lr·(g + λp); p ← p + v.  Bit-identical to the
+    pre-registry inline implementation (the default)."""
+
+    NAME = "sgd"
+    SLOT_PREFIXES = ("velocity_",)
+    CONSUMED_HYPERS = ("learning_rate", "weights_decay",
+                       "gradient_moment")
+    SLOT_BACKED_HYPERS = {"gradient_moment": "velocity_"}
+
+    def slots(self, attr, vec, gd):
+        # Historic condition: velocities exist only when the unit has
+        # any momentum at all (same names, same order — seeded
+        # trajectories depend on the state pytree being unchanged).
+        if gd.gradient_moment or gd.gradient_moment_bias:
+            yield "velocity_" + attr, vec.shape, vec.dtype
+
+    def update(self, attr, param, grad, state, hyper, traced=False):
+        lr = hyper["learning_rate"]
+        decay = hyper["weights_decay"]
+        moment = hyper["gradient_moment"]
+        slot = "velocity_" + attr
+        if traced:
+            # Traced values: no Python truth tests; the momentum
+            # branch is decided by the (static) presence of the slot.
+            g = grad + decay * param
+            if slot in state:
+                v = moment * state[slot] - lr * g
+                return param + v, {slot: v}
+            return param - lr * g, {}
+        g = grad + decay * param if decay else grad
+        if moment and slot in state:
+            v = moment * state[slot] - lr * g
+            return param + v, {slot: v}
+        return param - lr * g, {}
+
+
+@register
+class Adam(Optimizer):
+    """Adam (Kingma & Ba): first/second moment EWMAs with bias
+    correction; L2 decay folded into the gradient (classic Adam —
+    see :class:`AdamW` for the decoupled variant).
+
+    Slots per parameter: ``adam_m_``/``adam_v_`` (parameter-shaped,
+    f32) and ``adam_t_`` (a scalar step counter — per parameter, so
+    the update stays a pure per-slot rule with no cross-parameter
+    ordering dependence inside the fused step)."""
+
+    NAME = "adam"
+    SLOT_PREFIXES = ("adam_m_", "adam_v_", "adam_t_")
+    EXTRA_HYPERS = ("beta1", "beta2", "eps")
+    CONSUMED_HYPERS = ("learning_rate", "weights_decay",
+                       "beta1", "beta2", "eps")
+    HYPER_DEFAULTS = {"beta1": 0.9, "beta2": 0.999, "eps": 1e-8}
+
+    def slots(self, attr, vec, gd):
+        yield "adam_m_" + attr, vec.shape, numpy.float32
+        yield "adam_v_" + attr, vec.shape, numpy.float32
+        yield "adam_t_" + attr, (), numpy.float32
+
+    def _moments(self, attr, grad_eff, state, hyper):
+        import jax.numpy as jnp
+        b1, b2 = hyper["beta1"], hyper["beta2"]
+        t = state["adam_t_" + attr] + 1.0
+        m = b1 * state["adam_m_" + attr] + (1.0 - b1) * grad_eff
+        v = b2 * state["adam_v_" + attr] + \
+            (1.0 - b2) * jnp.square(grad_eff)
+        mhat = m / (1.0 - b1 ** t)
+        vhat = v / (1.0 - b2 ** t)
+        direction = mhat / (jnp.sqrt(vhat) + hyper["eps"])
+        return direction, {"adam_m_" + attr: m, "adam_v_" + attr: v,
+                           "adam_t_" + attr: t}
+
+    def update(self, attr, param, grad, state, hyper, traced=False):
+        lr, decay = hyper["learning_rate"], hyper["weights_decay"]
+        g = grad + decay * param if (traced or decay) else grad
+        direction, new_slots = self._moments(attr, g, state, hyper)
+        return param - (lr * direction).astype(param.dtype), new_slots
+
+
+@register
+class AdamW(Adam):
+    """AdamW (Loshchilov & Hutter): Adam moments with DECOUPLED
+    weight decay — p ← p − lr·(m̂/(√v̂+ε) + λp).  Shares Adam's slot
+    prefixes: the moment state is the same kind, so switching
+    adam ↔ adamw resumes cleanly from either's snapshot."""
+
+    NAME = "adamw"
+
+    def update(self, attr, param, grad, state, hyper, traced=False):
+        lr, decay = hyper["learning_rate"], hyper["weights_decay"]
+        direction, new_slots = self._moments(attr, grad, state, hyper)
+        step = lr * direction + (lr * decay) * param
+        return param - step.astype(param.dtype), new_slots
+
+
+@register
+class Lion(Optimizer):
+    """Lion (Chen et al., "Symbolic Discovery of Optimization
+    Algorithms"): sign-of-interpolated-momentum updates with
+    decoupled decay — u = sign(β1·m + (1−β1)·g);
+    p ← p − lr·(u + λp); m ← β2·m + (1−β2)·g.  HALF of Adam's state
+    (one slot per parameter), the memory argument for ZeRO at scale."""
+
+    NAME = "lion"
+    SLOT_PREFIXES = ("lion_m_",)
+    EXTRA_HYPERS = ("beta1", "beta2")
+    CONSUMED_HYPERS = ("learning_rate", "weights_decay",
+                       "beta1", "beta2")
+    HYPER_DEFAULTS = {"beta1": 0.9, "beta2": 0.99}
+
+    def slots(self, attr, vec, gd):
+        yield "lion_m_" + attr, vec.shape, numpy.float32
+
+    def update(self, attr, param, grad, state, hyper, traced=False):
+        import jax.numpy as jnp
+        lr, decay = hyper["learning_rate"], hyper["weights_decay"]
+        b1, b2 = hyper["beta1"], hyper["beta2"]
+        m = state["lion_m_" + attr]
+        u = jnp.sign(b1 * m + (1.0 - b1) * grad)
+        step = lr * u + (lr * decay) * param
+        new_m = b2 * m + (1.0 - b2) * grad
+        return param - step.astype(param.dtype), \
+            {"lion_m_" + attr: new_m}
+
+
+def init_parser(parser):
+    """Optimizer/ZeRO flags for the aggregated velescli parser."""
+    parser.add_argument(
+        "--optimizer", default=None, choices=sorted(OPTIMIZERS),
+        help="update rule for GD units that do not pin one "
+             "explicitly: sgd (momentum SGD, the bit-identical "
+             "default), adam, adamw, or lion (sets "
+             "root.common.engine.optimizer; resuming a snapshot "
+             "under a different optimizer than it was trained with "
+             "fails with an actionable slot-mismatch error)")
+    parser.add_argument(
+        "--zero", type=int, default=None, choices=(0, 1, 2),
+        help="ZeRO optimizer-state sharding over the mesh's data "
+             "axis for multi-controller SPMD runs: 1 shards the "
+             "optimizer slots (each dp rank stores 1/dp), 2 "
+             "additionally reduce-scatters the gradients feeding "
+             "them, 0 disables (sets root.common.engine.zero; see "
+             "docs/optimizers.md)")
