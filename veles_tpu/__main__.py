@@ -572,16 +572,16 @@ class Main(Logger, CommandLineBase):
             self.info("peak RSS: %.1f MB; wall time: %.1fs",
                       peak_kb / 1024.0,
                       time.time() - self._start_time)
-        except Exception:
-            pass
+        except Exception as e:
+            self.debug("peak-RSS report unavailable: %s", e)
         try:
             import jax
             stats = jax.local_devices()[0].memory_stats()
             if stats and "peak_bytes_in_use" in stats:
                 self.info("peak device memory: %.1f MB",
                           stats["peak_bytes_in_use"] / 1e6)
-        except Exception:
-            pass
+        except Exception as e:
+            self.debug("device-memory report unavailable: %s", e)
 
 
 def main(argv=None):

@@ -52,8 +52,10 @@ def enable_compilation_cache():
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs",
                               1.0)
-        except Exception:  # older/newer jax without the knob
-            pass
+        except Exception as e:  # older/newer jax without the knob
+            import logging
+            logging.getLogger("StepCompiler").debug(
+                "persistent compile cache unavailable: %s", e)
     _cache_enabled[0] = True
 
 
@@ -317,6 +319,10 @@ class StepCompiler(object):
     def compile(self):
         import jax
 
+        # Compile sentinel (analysis.runtime.strict_step): a re-trace
+        # inside a wrapped steady-state region is a hot-path bug.
+        from .analysis import runtime as _art
+        _art.note_compile("step:%s" % type(self.workflow).__name__)
         enable_compilation_cache()
         self.analyze()
         param_vecs = self._collect("params")
@@ -327,7 +333,11 @@ class StepCompiler(object):
         batch_vecs = list(self.batch_vectors)
         const_ids = [str(id(v)) for v in self.const_vectors]
         const_vecs = list(self.const_vectors)
-        persist_ids = [str(id(v)) for v in self.persist_vectors]
+        # (str key for the outputs dict the executor reads, int key
+        # for the bag — paired HERE so the traced closure never
+        # parses strings.)
+        persist_ids = [(str(id(v)), id(v))
+                       for v in self.persist_vectors]
         pname = self.param_name
         # Health sentinel (guardian.py): evaluators expose a
         # ``health_acc`` state row; the step accumulates per-class
@@ -435,8 +445,8 @@ class StepCompiler(object):
                                  state=ustate or None) or {}
                 for a, val in upd.items():
                     new_states[pname(u, a)] = val
-            outputs = {pid: bag[int(pid)] for pid in persist_ids
-                       if int(pid) in bag}
+            outputs = {pid: bag[vid] for pid, vid in persist_ids
+                       if vid in bag}
             metrics = dict(ctx.metrics)
             loss = ctx.loss
             if loss is not None:
@@ -674,10 +684,24 @@ class StepCompiler(object):
                              leaf=self._sync_leaf(metrics, new_states))
         return metrics
 
+    def _training_flag(self, training):
+        """The traced 0/1 training scalar as a CACHED device array:
+        building it per dispatch with ``jnp.float32(...)`` is an
+        implicit host→device scalar transfer every block — exactly
+        what ``analysis.runtime.strict_step`` exists to forbid."""
+        flags = getattr(self, "_train_flags_", None)
+        if flags is None:
+            import jax
+            import numpy
+            flags = self._train_flags_ = (
+                jax.device_put(numpy.float32(0.0)),
+                jax.device_put(numpy.float32(1.0)))
+        return flags[1 if training else 0]
+
     def execute_block(self, blocks, training, key=None):
         """Dispatches K stacked ticks at once; ``blocks`` maps batch
         vector id → (K, ...) numpy/jax array."""
-        import jax.numpy as jnp
+        import jax
         from .observability import attribution
         from .observability import tracing
         if not self._compiled or self.fingerprint() != self._fingerprint:
@@ -689,7 +713,11 @@ class StepCompiler(object):
             from . import prng
             key = prng.get().jax_key()
         ticks = next(iter(blocks.values())).shape[0] if blocks else 1
-        flag = jnp.float32(1.0 if training else 0.0)
+        # The stacked tick upload is EXPLICIT (device_put) so the
+        # strict-step transfer guard distinguishes it from a stray
+        # host-sync inside the hot loop.
+        blocks = {k: jax.device_put(v) for k, v in blocks.items()}
+        flag = self._training_flag(training)
         flops = self._maybe_flops(("block", ticks), self._block,
                                   params, states, blocks, consts,
                                   key, flag)

@@ -1,0 +1,27 @@
+"""veles-lint: project-aware static analysis + runtime enforcers.
+
+``python -m veles_tpu.analysis`` lints the repo (zero findings is a
+tier-1 gate — tests/test_static_analysis.py); the submodules are
+reusable passes:
+
+* :mod:`.core` — file model, rule catalog, suppressions, baselines;
+* :mod:`.callgraph` — VL101/VL102 trace hazards via a call-graph
+  walk from the jit entry points;
+* :mod:`.locks` — VL201 guarded-by discipline + VL202 static lock
+  order;
+* :mod:`.registries` — VL301 literal observability names + VL302
+  silent broad excepts;
+* :mod:`.runtime` — the :class:`~.runtime.LockOrderRecorder` and
+  :func:`~.runtime.strict_step` runtime enforcers.
+
+See docs/analysis.md for the catalog and conventions.
+"""
+
+from .core import (Finding, RULES, apply_baseline, baseline_key,  # noqa
+                   default_targets, format_finding, load_baseline,
+                   repo_root, run, write_baseline)
+
+
+def main(argv=None):
+    from .__main__ import main as _main
+    return _main(argv)

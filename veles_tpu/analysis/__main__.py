@@ -1,0 +1,65 @@
+"""CLI for veles-lint: ``python -m veles_tpu.analysis``.
+
+Emits findings as ``path:line: RULE-ID message`` (greppable; exit 1
+when any unsuppressed, un-baselined finding remains).  ``--baseline``
+subtracts a recorded finding set; ``--write-baseline`` records the
+current one (the adopt-then-burn-down workflow, docs/analysis.md).
+"""
+
+import argparse
+import sys
+
+from . import core
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu.analysis",
+        description="veles-lint: project-aware static analysis "
+                    "(trace hazards, lock discipline, registry "
+                    "contracts)")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: veles_tpu/, "
+             "bench.py, __graft_entry__.py)")
+    parser.add_argument(
+        "--baseline", metavar="FILE", default=None,
+        help="suppress findings recorded in FILE")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current findings to --baseline FILE "
+             "(or .veleslint-baseline) and exit 0")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit")
+    parser.add_argument(
+        "--quiet", action="store_true",
+        help="suppress the summary line (findings only)")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        for rule in sorted(core.RULES):
+            print("%s  %s" % (rule, core.RULES[rule]))
+        return 0
+    root = core.repo_root()
+    paths = args.paths or None
+    findings = core.run(paths=paths, root=root)
+    baseline_path = args.baseline
+    if args.write_baseline:
+        baseline_path = baseline_path or ".veleslint-baseline"
+        core.write_baseline(baseline_path, findings)
+        print("wrote %d finding(s) to %s" %
+              (len(findings), baseline_path))
+        return 0
+    if baseline_path:
+        findings = core.apply_baseline(
+            findings, core.load_baseline(baseline_path))
+    for f in findings:
+        print(core.format_finding(f))
+    if not args.quiet:
+        print("veles-lint: %d finding(s)" % len(findings),
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,285 @@
+"""Lock-discipline pass (VL201/VL202).
+
+**VL201 — guarded-by annotations.**  A field of a threaded class is
+annotated where it is initialized::
+
+    self._pending = collections.deque()  # guarded-by: _cond
+
+(or on a ``#:``/``#`` comment line directly above the assignment).
+Every other write to that field — plain/augmented/subscript
+assignment, ``del``, or a mutating method call (``append``, ``pop``,
+``update``, …) — must then sit lexically inside ``with
+self.<lock>:``.  Three contexts are exempt by convention:
+
+* construction (``__init__`` / ``init_unpickled`` / ``__setstate__``
+  / ``__del__``): the object is not shared yet (or no longer);
+* methods whose name ends in ``_locked``: the project's
+  caller-holds-the-lock convention (``_fail_queued_locked``);
+* an inline ``# lint-ok: VL201 reason`` for the rare justified case.
+
+**VL202 — static acquisition-order graph.**  Within each class the
+pass records the lexical nesting of ``with self.<lock>:`` blocks
+(plus one level of same-class call expansion: acquiring B inside a
+method called under A orders A→B) and reports any cycle in the
+resulting directed graph.  Cross-object cycles are the RUNTIME
+recorder's job (analysis.runtime.LockOrderRecorder) — static and
+runtime enforcement split the problem deliberately.
+"""
+
+import ast
+import re
+
+from .core import Finding
+
+_GUARDED_RE = re.compile(r"#[:\s]*guarded-by:\s*"
+                         r"(?:self\.)?([A-Za-z_][A-Za-z0-9_]*)")
+
+#: Lock-ish constructors: a ``self.X = <ctor>(...)`` marks X a lock.
+_LOCK_CTORS = frozenset(("Lock", "RLock", "Condition", "SniffedLock"))
+
+#: Container methods that mutate their receiver.
+_MUTATORS = frozenset((
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "update", "setdefault", "sort", "reverse",
+))
+
+#: Methods where unguarded writes are construction, not racing.
+_CTOR_METHODS = frozenset(("__init__", "init_unpickled",
+                           "__setstate__", "__del__"))
+
+
+class ClassScan(object):
+    def __init__(self, sf, node):
+        self.sf = sf
+        self.node = node
+        self.locks = set()
+        self.guarded = {}   # field -> (lock, decl lineno)
+        self._find_locks()
+        self._find_annotations()
+
+    def _methods(self):
+        for item in self.node.body:
+            if isinstance(item, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                yield item
+
+    def _find_locks(self):
+        for method in self._methods():
+            for sub in ast.walk(method):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                if not isinstance(sub.value, ast.Call):
+                    continue
+                func = sub.value.func
+                name = func.attr if isinstance(func, ast.Attribute) \
+                    else getattr(func, "id", None)
+                if name not in _LOCK_CTORS:
+                    continue
+                for target in sub.targets:
+                    if isinstance(target, ast.Attribute) and \
+                            isinstance(target.value, ast.Name) and \
+                            target.value.id == "self":
+                        self.locks.add(target.attr)
+
+    def _find_annotations(self):
+        """guarded-by comments inside the class body, attached to the
+        ``self.field = …`` assignment on the same line or on the
+        first assignment within the next 3 lines (comment-above
+        style)."""
+        assign_at = {}
+        for method in self._methods():
+            for sub in ast.walk(method):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = sub.targets if isinstance(
+                        sub, ast.Assign) else [sub.target]
+                    for target in targets:
+                        if isinstance(target, ast.Attribute) and \
+                                isinstance(target.value, ast.Name) \
+                                and target.value.id == "self":
+                            assign_at.setdefault(sub.lineno,
+                                                 target.attr)
+        end = getattr(self.node, "end_lineno",
+                      self.node.lineno + 10000)
+        for lineno in range(self.node.lineno, end + 1):
+            m = _GUARDED_RE.search(self.sf.line_text(lineno))
+            if not m:
+                continue
+            lock = m.group(1)
+            for cand in range(lineno, min(lineno + 4, end + 1)):
+                field = assign_at.get(cand)
+                if field is not None:
+                    self.guarded[field] = (lock, lineno)
+                    break
+
+    # -- write enforcement -------------------------------------------------
+
+    def check_writes(self):
+        findings = []
+        for method in self._methods():
+            if method.name in _CTOR_METHODS or \
+                    method.name.endswith("_locked"):
+                continue
+            findings.extend(self._check_method(method))
+        return findings
+
+    def _with_locks(self, node):
+        """Lock attrs acquired by a With statement's items."""
+        out = []
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self":
+                out.append(expr.attr)
+            elif isinstance(expr, ast.Call) and \
+                    isinstance(expr.func, ast.Attribute) and \
+                    isinstance(expr.func.value, ast.Name) and \
+                    expr.func.value.id == "self" and \
+                    expr.func.attr == "data_threadsafe":
+                out.append("_data_lock_")
+        return out
+
+    def _check_method(self, method):
+        findings = []
+        cls = self.node.name
+
+        def visit(node, held):
+            if isinstance(node, ast.With):
+                held = held | set(self._with_locks(node))
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef,
+                                   ast.Lambda)):
+                # A nested def's body executes later, under whatever
+                # locks ITS caller holds — start it from scratch so
+                # a callback defined under the lock is not assumed
+                # to run under it.
+                held = frozenset()
+            self._check_node(node, held, cls, method, findings)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        visit(method, frozenset())
+        return findings
+
+    def _field_of(self, expr):
+        """The self-attribute a write expression targets, unwrapping
+        subscripts (``self.f[k] = v`` writes f)."""
+        while isinstance(expr, ast.Subscript):
+            expr = expr.value
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            return expr.attr
+        return None
+
+    def _check_node(self, node, held, cls, method, findings):
+        writes = []
+        if isinstance(node, ast.Assign):
+            writes = [self._field_of(t) for t in node.targets]
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            writes = [self._field_of(node.target)]
+        elif isinstance(node, ast.Delete):
+            writes = [self._field_of(t) for t in node.targets]
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            writes = [self._field_of(node.func.value)]
+        for field in writes:
+            if field is None or field not in self.guarded:
+                continue
+            lock, _decl = self.guarded[field]
+            if lock in held:
+                continue
+            findings.append(Finding(
+                self.sf.rel, node.lineno, "VL201",
+                "%s.%s is `guarded-by: %s` but written in %s() "
+                "outside `with self.%s`" %
+                (cls, field, lock, method.name, lock)))
+
+    # -- acquisition order -------------------------------------------------
+
+    def order_edges(self):
+        """[(outer, inner, lineno)] lock-order edges this class's
+        methods establish, with one level of same-class call
+        expansion."""
+        method_locks = {}
+        for method in self._methods():
+            acquired = set()
+            for sub in ast.walk(method):
+                if isinstance(sub, ast.With):
+                    acquired.update(self._with_locks(sub))
+            method_locks[method.name] = acquired
+        edges = []
+
+        def visit(node, held, method_name):
+            if isinstance(node, ast.With):
+                new = self._with_locks(node)
+                for inner in new:
+                    for outer in held:
+                        if outer != inner:
+                            edges.append((outer, inner, node.lineno))
+                held = held | set(new)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.Lambda)):
+                if method_name is not None:
+                    held = frozenset()
+            elif isinstance(node, ast.Call) and held and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self":
+                for inner in method_locks.get(node.func.attr, ()):
+                    for outer in held:
+                        if outer != inner:
+                            edges.append((outer, inner, node.lineno))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held, method_name)
+
+        for method in self._methods():
+            visit(method, frozenset(), method.name)
+        return edges
+
+
+def _find_cycles(graph):
+    """Simple DFS cycle finder; returns a list of cycles (each a list
+    of nodes, smallest-first canonical rotation, deduplicated)."""
+    cycles = set()
+
+    def dfs(node, path, on_path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_path:
+                cyc = path[path.index(nxt):]
+                pivot = cyc.index(min(cyc))
+                cycles.add(tuple(cyc[pivot:] + cyc[:pivot]))
+            elif len(path) < 16:
+                dfs(nxt, path + [nxt], on_path | {nxt})
+
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return [list(c) for c in sorted(cycles)]
+
+
+def run(project):
+    findings = []
+    graph = {}
+    sites = {}
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            scan = ClassScan(sf, node)
+            if scan.guarded:
+                findings.extend(scan.check_writes())
+            for outer, inner, lineno in scan.order_edges():
+                a = "%s.%s" % (node.name, outer)
+                b = "%s.%s" % (node.name, inner)
+                graph.setdefault(a, set()).add(b)
+                sites.setdefault((a, b), (sf.rel, lineno))
+    for cycle in _find_cycles(graph):
+        edge = (cycle[0], cycle[1 % len(cycle)])
+        rel, lineno = sites.get(edge, (project.files[0].rel, 1))
+        findings.append(Finding(
+            rel, lineno, "VL202",
+            "lock-acquisition-order cycle: %s" %
+            " -> ".join(cycle + [cycle[0]])))
+    return findings

@@ -1,0 +1,222 @@
+"""Runtime enforcers backing the static rules.
+
+Two tripwires the linter cannot prove statically:
+
+* :class:`LockOrderRecorder` — a process-wide debug recorder every
+  :class:`~veles_tpu.distributable.SniffedLock` reports to when
+  enabled.  It keeps a per-thread stack of held locks; each
+  acquisition adds held→new edges to a global graph, and
+  :meth:`LockOrderRecorder.assert_acyclic` (test teardown) raises
+  with the offending chain when two code paths ever ordered the same
+  locks differently.  Nodes are per-INSTANCE (``name#seq``) so two
+  units sharing a lock *name* cannot fabricate a cycle.  Disabled
+  (the default) the hook is one ``is None`` check per acquisition.
+
+* :func:`strict_step` — wraps a steady-state hot region in
+  ``jax.transfer_guard("disallow")`` (any implicit host↔device
+  transfer raises inside the region) **and** a compile sentinel:
+  :func:`note_compile` is called by ``StepCompiler.compile`` and by
+  the serving ``CompileCache`` on every miss, and ``strict_step``
+  raises :class:`StrictStepViolation` when the region compiled more
+  than its ``allowed_compiles`` budget.  This hardens the
+  ``Vector.host_sync_count`` *pins* into *enforcement*: a stray
+  ``.item()`` or a bucket-key bug now fails the wrapped test instead
+  of silently costing MFU.
+"""
+
+import contextlib
+import threading
+
+#: Registered chaos/enforcement counters (greppable literals — the
+#: docs-consistency + VL301 contracts).
+_STAT_COMPILES = "analysis.compiles"
+_STAT_EDGES = "analysis.lock_edges"
+
+
+class LockOrderViolation(AssertionError):
+    """Two code paths acquired the same locks in opposite orders."""
+
+
+class StrictStepViolation(AssertionError):
+    """A strict_step region compiled past its budget."""
+
+
+class LockOrderRecorder(object):
+    """Process-wide lock-acquisition-order graph (debug tool)."""
+
+    def __init__(self):
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        #: (outer_id, inner_id) -> "thread/outer->inner" first site.
+        self.edges = {}
+
+    # -- hooks (SniffedLock calls these when a recorder is live;
+    # -- node ids are the locks' own per-instance order_ids) ---------------
+
+    def note_acquire(self, lock_id):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        if held:
+            thread = threading.current_thread().name
+            with self._lock:
+                for outer in held:
+                    if outer == lock_id:
+                        continue
+                    edge = (outer, lock_id)
+                    if edge not in self.edges:
+                        self.edges[edge] = thread
+        held.append(lock_id)
+
+    def note_release(self, lock_id):
+        held = getattr(self._tls, "held", None)
+        if held and lock_id in held:
+            # Remove the LAST occurrence: locks release LIFO in the
+            # with-statement world this records.
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] == lock_id:
+                    del held[i]
+                    break
+
+    # -- analysis ----------------------------------------------------------
+
+    def graph(self):
+        with self._lock:
+            graph = {}
+            for outer, inner in self.edges:
+                graph.setdefault(outer, set()).add(inner)
+            return graph
+
+    def find_cycle(self):
+        """One acquisition-order cycle as a node list, or None."""
+        graph = self.graph()
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {}
+
+        def dfs(node, path):
+            color[node] = GRAY
+            for nxt in sorted(graph.get(node, ())):
+                c = color.get(nxt, WHITE)
+                if c == GRAY:
+                    return path[path.index(nxt):] + [nxt]
+                if c == WHITE:
+                    found = dfs(nxt, path + [nxt])
+                    if found:
+                        return found
+            color[node] = BLACK
+            return None
+
+        for start in sorted(graph):
+            if color.get(start, WHITE) == WHITE:
+                found = dfs(start, [start])
+                if found:
+                    return found
+        return None
+
+    def assert_acyclic(self):
+        """Raises :class:`LockOrderViolation` naming the cycle; the
+        canonical test-teardown check."""
+        cycle = self.find_cycle()
+        if cycle is not None:
+            with self._lock:
+                sites = {e: t for e, t in self.edges.items()}
+            detail = []
+            for a, b in zip(cycle, cycle[1:]):
+                detail.append("%s -> %s (thread %s)" %
+                              (a, b, sites.get((a, b), "?")))
+            raise LockOrderViolation(
+                "lock-acquisition-order cycle:\n  " +
+                "\n  ".join(detail))
+
+    def edge_count(self):
+        with self._lock:
+            return len(self.edges)
+
+
+#: The live recorder, or None (disabled — the hot-path state).
+_recorder = None
+_recorder_guard = threading.Lock()
+
+
+def recorder():
+    """The live :class:`LockOrderRecorder`, or None when disabled."""
+    return _recorder
+
+
+def enable_lock_order():
+    """Installs (or returns) the process-wide recorder."""
+    global _recorder
+    with _recorder_guard:
+        if _recorder is None:
+            _recorder = LockOrderRecorder()
+        return _recorder
+
+
+def disable_lock_order():
+    global _recorder
+    with _recorder_guard:
+        rec, _recorder = _recorder, None
+    return rec
+
+
+@contextlib.contextmanager
+def lock_order_recording():
+    """Scoped recorder: enables, yields it, disables, and asserts
+    the recorded graph is acyclic on clean exit."""
+    rec = enable_lock_order()
+    try:
+        yield rec
+    finally:
+        disable_lock_order()
+    rec.assert_acyclic()
+
+
+# -- compile sentinel ------------------------------------------------------
+
+_compile_lock = threading.Lock()
+_compile_count = [0]
+_recent_compiles = []
+
+
+def note_compile(tag):
+    """Called by every project compile path (``StepCompiler.compile``,
+    serving ``CompileCache`` misses) so :func:`strict_step` can prove
+    a steady-state region stayed compile-free."""
+    with _compile_lock:
+        _compile_count[0] += 1
+        _recent_compiles.append(str(tag))
+        del _recent_compiles[:-16]
+    from .. import resilience
+    resilience.stats.incr(_STAT_COMPILES)
+
+
+def compile_count():
+    with _compile_lock:
+        return _compile_count[0]
+
+
+@contextlib.contextmanager
+def strict_step(allowed_compiles=0, transfer="disallow"):
+    """Strict steady-state region: implicit host↔device transfers
+    raise immediately (``jax.transfer_guard``), and compiling more
+    than ``allowed_compiles`` programs inside the region raises
+    :class:`StrictStepViolation` naming the offending compile keys.
+
+    Wrap the fused training step or the serving decode loop AFTER
+    warmup::
+
+        with strict_step():
+            workflow.execute_step(trigger=unit)
+    """
+    import jax
+    base = compile_count()
+    with jax.transfer_guard(transfer):
+        yield
+    grew = compile_count() - base
+    if grew > allowed_compiles:
+        with _compile_lock:
+            recent = list(_recent_compiles[-grew:])
+        raise StrictStepViolation(
+            "strict_step region compiled %d program(s) "
+            "(budget %d): %s" % (grew, allowed_compiles,
+                                 ", ".join(recent)))

@@ -259,8 +259,9 @@ class Client(Logger):
         effort: a dead channel simply degrades to the drop path."""
         try:
             chan.send({"cmd": "bye"})
-        except Exception:
-            pass
+        except Exception as e:
+            self.debug("goodbye frame not delivered (%s) — the "
+                       "master takes the drop path", e)
 
     def _nojob_backoff(self):
         """Jittered exponential no-job backoff on the shared

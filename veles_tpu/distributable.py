@@ -12,14 +12,18 @@ joins, what it re-applies on elastic reconfiguration, and what it does
 when a worker is dropped.
 """
 
+import itertools
 import logging
 import threading
 
+from .analysis.runtime import recorder as _recorder
 from .logger import Logger
 
 #: Seconds after which a lock acquisition is logged as a suspected
 #: deadlock (reference: distributable.py:139-157, DEADLOCK_TIME=4).
 DEADLOCK_TIME = 4.0
+
+_lock_seq = itertools.count(1)
 
 
 class SniffedLock(object):
@@ -28,16 +32,35 @@ class SniffedLock(object):
     blocked call site, then acquisition blocks normally (reference:
     distributable.py:139-157 ``_data_threadsafe``).  High-confusion-
     cost bugs in a threaded control plane announce themselves instead
-    of hanging silently."""
+    of hanging silently.
+
+    When the :mod:`veles_tpu.analysis.runtime` lock-order recorder is
+    enabled (tests, debug runs), every acquisition also reports to
+    the process-wide acquisition-order graph under this instance's
+    unique ``order_id`` — cycle detection at test teardown catches
+    inverted lock orders that never happened to deadlock in the run.
+    Disabled (the default), the hook is one function call returning
+    None per acquisition."""
 
     def __init__(self, name="lock", deadline=DEADLOCK_TIME,
                  logger=None):
         self._lock = threading.Lock()
         self.name = name
+        #: Per-INSTANCE node id in the lock-order graph: two units
+        #: sharing a lock NAME must not fabricate a cycle.
+        self.order_id = "%s#%d" % (name, next(_lock_seq))
         self.deadline = deadline
         self._log = logger or logging.getLogger("SniffedLock")
 
     def acquire(self, blocking=True, timeout=-1):
+        ok = self._acquire_sniffed(blocking, timeout)
+        if ok:
+            rec = _recorder()
+            if rec is not None:
+                rec.note_acquire(self.order_id)
+        return ok
+
+    def _acquire_sniffed(self, blocking, timeout):
         if not blocking or 0 <= timeout <= self.deadline:
             return self._lock.acquire(blocking, timeout)
         if self._lock.acquire(timeout=self.deadline):
@@ -51,6 +74,9 @@ class SniffedLock(object):
         return self._lock.acquire(timeout=timeout - self.deadline)
 
     def release(self):
+        rec = _recorder()
+        if rec is not None:
+            rec.note_release(self.order_id)
         self._lock.release()
 
     def locked(self):

@@ -31,6 +31,7 @@ import time
 
 import numpy
 
+from .distributable import SniffedLock
 from .error import Bug
 from .json_encoders import dumps_json
 
@@ -368,17 +369,17 @@ class KVBlockPool(object):
         self.storage = storage
         self._copy_fn = copy_fn
         self.prefix_capacity = int(prefix_capacity)
-        self._lock = threading.Lock()
+        self._lock = SniffedLock(name="KVBlockPool.lock")
         # LIFO free list: recently-freed blocks are re-used first
         # (their pages are warm).  Block 0 (trash) is never free.
-        self._free = list(range(n_blocks - 1, 0, -1))
-        self._refs = {}
+        self._free = list(range(n_blocks - 1, 0, -1))  # guarded-by: _lock
+        self._refs = {}  # guarded-by: _lock
         # digest -> tuple(block ids); OrderedDict as LRU (most
         # recently hit last).  Entries hold one ref per block.
-        self._prefix = collections.OrderedDict()
-        self.prefix_hits = 0
-        self.prefix_misses = 0
-        self.cow_copies = 0
+        self._prefix = collections.OrderedDict()  # guarded-by: _lock
+        self.prefix_hits = 0  # guarded-by: _lock
+        self.prefix_misses = 0  # guarded-by: _lock
+        self.cow_copies = 0  # guarded-by: _lock
 
     @property
     def usable(self):
@@ -1603,7 +1604,9 @@ class ExportedModel(object):
             return jax.jit(run, donate_argnums=(0, 1))
 
         fn = self.compile_cache.get_or_build(key, build)
-        return fn(ks, vs, numpy.int32(src), numpy.int32(dst))
+        src_dst = jax.device_put((numpy.int32(src),
+                                  numpy.int32(dst)))
+        return fn(ks, vs, *src_dst)
 
     def _paged_block(self, p, x, pk, pv, tables, wblock, wslot,
                      key_mask, n_heads):
@@ -1780,6 +1783,7 @@ class ExportedModel(object):
         Compiles once per (B, Sc, T, n_blocks, block_size) — POOL
         GEOMETRY IS PART OF THE KEY: resizing the pool or its blocks
         must never serve a stale program."""
+        import jax
         tables = numpy.ascontiguousarray(tables, dtype=numpy.int32)
         tokens = numpy.ascontiguousarray(tokens, dtype=numpy.int32)
         B, T = tables.shape
@@ -1788,12 +1792,16 @@ class ExportedModel(object):
             ("pext", B, Sc, T, pool.n_blocks, pool.block_size),
             lambda: self._build_paged_extend(Sc, T, pool.block_size))
         ks, vs = pool.storage
-        ks, vs, tok0 = fn(
-            self._lm_params(), ks, vs, tables, tokens,
+        # EXPLICIT upload of the per-call host arrays: the serving
+        # decode loop runs under analysis.runtime.strict_step, where
+        # an implicit numpy→device transfer at dispatch raises.
+        args = jax.device_put((
+            tables, tokens,
             numpy.ascontiguousarray(prior, dtype=numpy.int32),
             numpy.ascontiguousarray(chunk_lens, dtype=numpy.int32),
             numpy.ascontiguousarray(temps, dtype=numpy.float32),
-            numpy.ascontiguousarray(seeds, dtype=numpy.uint32))
+            numpy.ascontiguousarray(seeds, dtype=numpy.uint32)))
+        ks, vs, tok0 = fn(self._lm_params(), ks, vs, *args)
         pool.storage = (ks, vs)
         return numpy.asarray(tok0)
 
@@ -1802,19 +1810,22 @@ class ExportedModel(object):
         """One decode step for the engine's continuous batch: every
         active row advances one token through the pool.  Compiles
         once per (B, T, n_blocks, block_size)."""
+        import jax
         tables = numpy.ascontiguousarray(tables, dtype=numpy.int32)
         B, T = tables.shape
         fn = self.compile_cache.get_or_build(
             ("pstep", B, T, pool.n_blocks, pool.block_size),
             lambda: self._build_paged_step(T, pool.block_size))
         ks, vs = pool.storage
-        ks, vs, tok_new = fn(
-            self._lm_params(), ks, vs, tables,
+        # Explicit upload — see paged_extend (strict_step contract).
+        args = jax.device_put((
+            tables,
             numpy.ascontiguousarray(pos, dtype=numpy.int32),
             numpy.ascontiguousarray(tok, dtype=numpy.int32),
             numpy.ascontiguousarray(gen_idx, dtype=numpy.int32),
             numpy.ascontiguousarray(temps, dtype=numpy.float32),
-            numpy.ascontiguousarray(seeds, dtype=numpy.uint32))
+            numpy.ascontiguousarray(seeds, dtype=numpy.uint32)))
+        ks, vs, tok_new = fn(self._lm_params(), ks, vs, *args)
         pool.storage = (ks, vs)
         return numpy.asarray(tok_new)
 

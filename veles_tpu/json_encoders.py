@@ -24,8 +24,8 @@ class NumpyJSONEncoder(json.JSONEncoder):
             # jax.Array scalars and 0-d arrays.
             try:
                 return obj.item()
-            except Exception:
-                pass
+            except (TypeError, ValueError):
+                pass  # not a scalar after all: fall through
         if isinstance(obj, (set, frozenset)):
             return sorted(obj)
         try:

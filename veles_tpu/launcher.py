@@ -22,6 +22,7 @@ import time
 
 from . import resilience
 from .config import root, get as config_get
+from .distributable import SniffedLock
 from .logger import Logger
 
 
@@ -88,7 +89,10 @@ class Launcher(Logger):
         # {master} is substituted with our address).
         self.nodes = list(kwargs.get("nodes") or [])
         self.worker_argv = list(kwargs.get("worker_argv") or [])
-        self._worker_procs = []
+        # Spawns race: the server's respawn hook fires from per-drop
+        # threads while the main thread may be launching/stopping.
+        self._procs_lock = SniffedLock(name="Launcher.procs_lock")
+        self._worker_procs = []  # guarded-by: _procs_lock
 
     # -- mode flags (reference API) ----------------------------------------
 
@@ -188,11 +192,23 @@ class Launcher(Logger):
             import jax
             # Idempotent across launchers in one process (genetics/
             # ensembles build a Launcher per candidate run).
-            if not jax.distributed.is_initialized():
-                jax.distributed.initialize(
-                    coordinator_address=self.coordinator_address,
-                    num_processes=self.num_processes,
-                    process_id=self.process_id)
+            # jax < 0.5 has no jax.distributed.is_initialized —
+            # probe when available, otherwise let the double-init
+            # RuntimeError mean "already up".
+            probe = getattr(jax.distributed, "is_initialized", None)
+            if probe is None or not probe():
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=self.coordinator_address,
+                        num_processes=self.num_processes,
+                        process_id=self.process_id)
+                except RuntimeError as e:
+                    if probe is not None or (
+                            "once" not in str(e) and
+                            "already" not in str(e).lower()):
+                        raise
+                    self.debug("jax.distributed already "
+                               "initialized: %s", e)
         self.device = kwargs.pop("device", None) or \
             backends.Device.create(
                 config_get(root.common.engine.backend, "auto"))
@@ -287,7 +303,8 @@ class Launcher(Logger):
             cmd = ["ssh", "-o", "BatchMode=yes", node, remote]
         self.info("spawning worker on %s: %s", node, " ".join(cmd))
         proc = subprocess.Popen(cmd)
-        self._worker_procs.append((node, proc))
+        with self._procs_lock:
+            self._worker_procs.append((node, proc))
         return proc
 
     def launch_remote_workers(self):
@@ -301,7 +318,9 @@ class Launcher(Logger):
         if not self.nodes:
             return "local"
         alive = {node: 0 for node in self.nodes}
-        for node, proc in self._worker_procs:
+        with self._procs_lock:
+            procs = list(self._worker_procs)
+        for node, proc in procs:
             if proc.poll() is None and node in alive:
                 alive[node] += 1
         return min(self.nodes, key=lambda n: alive[n])
@@ -467,7 +486,8 @@ class Launcher(Logger):
         try:
             from .observability import attribution
             perf = attribution.perf_summary()
-        except Exception:
+        except Exception as e:
+            self.debug("perf heartbeat section unavailable: %s", e)
             perf = None
         if perf:
             payload["perf"] = perf
@@ -478,7 +498,9 @@ class Launcher(Logger):
         try:
             from .serving.metrics import live_serving_summary
             serving = live_serving_summary()
-        except Exception:
+        except Exception as e:
+            self.debug("serving heartbeat section unavailable: %s",
+                       e)
             serving = None
         if serving:
             payload["serving"] = serving
@@ -491,7 +513,8 @@ class Launcher(Logger):
             try:
                 self._graph_dot_ = wf.generate_graph(
                     write_on_disk=False)
-            except Exception:
+            except Exception as e:
+                self.debug("workflow graph render failed: %s", e)
                 self._graph_dot_ = ""
         self._beat_count_ += 1
         if self._graph_dot_ and (self._beat_count_ == 1 or
@@ -574,7 +597,9 @@ class Launcher(Logger):
 
     def stop(self):
         self._heartbeat_stop.set()
-        for node, proc in self._worker_procs:
+        with self._procs_lock:
+            procs = list(self._worker_procs)
+        for node, proc in procs:
             if proc.poll() is None:
                 proc.terminate()
         if self.server is not None:

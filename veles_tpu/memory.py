@@ -201,7 +201,10 @@ class Vector(Pickleable):
                 try:
                     import jax
                     arr = jax.device_put(self._devmem_, value)
-                except Exception:
+                except Exception as e:
+                    import logging
+                    logging.getLogger("Vector").debug(
+                        "D2D reshard failed (%s) — host path", e)
                     arr = None
                 if arr is not None:
                     self.devmem = arr

@@ -103,5 +103,5 @@ class NativeModel(object):
     def __del__(self):
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception:  # lint-ok: VL302 interpreter teardown —
+            pass           # logging itself may already be gone

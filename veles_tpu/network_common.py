@@ -279,6 +279,11 @@ def decode_tensor_parts(payload, loads=None, max_message=None):
         skeleton, specs = (loads or pickle.loads)(
             bytes(view[4:4 + header_len]))
     except Exception:
+        # Peer-supplied bytes: undecodable reads as a dead peer (the
+        # caller drops + requeues) — but count it, or a skewed-build
+        # worker flapping forever would be invisible to operators.
+        from . import resilience
+        resilience.stats.incr("net.decode_error")
         return None
     offset = 4 + header_len
     budget = limit

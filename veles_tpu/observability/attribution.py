@@ -31,6 +31,7 @@ computation: bits on device are identical with attribution on, off,
 or absent.
 """
 
+import logging
 import threading
 import time
 
@@ -114,7 +115,9 @@ def peak_flops():
             import jax
             kind = str(getattr(jax.devices()[0], "device_kind",
                                "")).lower()
-        except Exception:
+        except Exception as e:
+            logging.getLogger("attribution").debug(
+                "device-kind probe failed: %s", e)
             kind = ""
         for sub, tflops in DEVICE_PEAK_TFLOPS:
             if sub in kind:
@@ -143,6 +146,10 @@ def _xprof_step_begin():
         jax.profiler.start_trace(_xprof["dir"])
         _xprof["started"] = True
     except Exception:
+        # The operator explicitly asked for a capture (--xprof):
+        # a disarm must be LOUD, not a mystery empty directory.
+        logging.getLogger("attribution").exception(
+            "xprof capture could not start — disarming")
         _xprof["dir"] = None  # unusable; disarm rather than retrying
 
 def _xprof_step_end(leaf):
@@ -155,8 +162,9 @@ def _xprof_step_end(leaf):
     try:
         import jax
         jax.profiler.stop_trace()
-    except Exception:
-        pass
+    except Exception as e:
+        logging.getLogger("attribution").debug(
+            "xprof stop_trace failed: %s", e)
     _xprof["started"] = False
     _xprof["dir"] = None
 
@@ -177,8 +185,9 @@ def _device_sync(leaf):
         if getattr(leaf, "ndim", 0):
             scalar = leaf.ravel()[0]
         numpy.array(jax.device_get(scalar))
-    except Exception:
-        pass
+    except Exception as e:
+        logging.getLogger("attribution").debug(
+            "device barrier fetch failed: %s", e)
 
 
 # -- per-dispatch hooks (called by StepCompiler) ---------------------------
@@ -287,7 +296,9 @@ def estimate_flops(jitted, *args):
             cost = cost[0] if cost else {}
         flops = float(cost.get("flops", 0.0))
         return flops if flops > 0 else None
-    except Exception:
+    except Exception as e:
+        logging.getLogger("attribution").debug(
+            "HLO cost analysis unavailable: %s", e)
         return None
 
 

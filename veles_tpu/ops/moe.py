@@ -65,6 +65,8 @@ def moe_ffn(x, router_w, w1, b1, w2, b2, capacity_factor=1.25):
     """
     T, D = x.shape
     E = router_w.shape[1]
+    # lint-ok: VL101 static shape math — T/E are Python ints, the
+    # capacity is a compile-time constant, never a traced value.
     capacity = max(1, int(capacity_factor * T / E))
     logits = x.astype(jnp.float32) @ router_w
     dispatch, combine, aux, load = top1_routing(logits, capacity)

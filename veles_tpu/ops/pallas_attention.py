@@ -404,7 +404,13 @@ def pallas_attention_available():
                 jax.block_until_ready(
                     pallas_attention(x, x, x, causal=True))
                 _available[0] = True
-            except Exception:
+            except Exception as e:
+                # The silent-fallback contract stands, but WHY the
+                # kernel is off must be discoverable.
+                import logging
+                logging.getLogger("pallas_attention").info(
+                    "flash kernel probe failed (%s) — xla fallback",
+                    e)
                 _available[0] = False
     return _available[0]
 

@@ -126,8 +126,11 @@ def lrn_pallas(x, n, alpha, beta, k, interpret=False):
 def _lrn_fwd(x, n, alpha, beta, k, interpret):
     c = x.shape[-1]
     flat = x.reshape(-1, c)
-    kern = functools.partial(_fwd_kernel, k=float(k),
-                             coef=float(alpha) / n, beta=float(beta))
+    # Static nondiff config scalars (custom_vjp nondiff_argnums),
+    # baked into the kernel — never traced values.
+    kern = functools.partial(
+        _fwd_kernel, k=float(k),                    # lint-ok: VL101
+        coef=float(alpha) / n, beta=float(beta))    # lint-ok: VL101
     y = _call(kern, (flat, band_matrix(c, n, jnp.float32)), c,
               x.dtype, interpret)
     return y.reshape(x.shape), x
@@ -150,7 +153,10 @@ lrn_pallas.defvjp(_lrn_fwd, _lrn_bwd)
 def tpu_available():
     try:
         dev = jax.devices()[0]
-    except Exception:
+    except Exception as e:
+        import logging
+        logging.getLogger("pallas_lrn").debug(
+            "no jax backend available: %s", e)
         return False
     return "tpu" in dev.device_kind.lower() or \
         dev.platform in ("tpu", "axon")

@@ -1,0 +1,16 @@
+"""Console entry for veles-lint (parity with generate_docs.py).
+
+``python -m veles_tpu.scripts.lint [PATHS] [--baseline FILE]
+[--write-baseline] [--list-rules] [--quiet]`` — a thin wrapper over
+:mod:`veles_tpu.analysis.__main__` so the linter sits beside the
+other operator scripts.  Findings print as ``path:line: RULE-ID
+message`` (greppable); exit 1 when any remain.
+"""
+
+import sys
+
+from ..analysis.__main__ import main
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,7 +173,7 @@ class Server(Logger):
         # Serializes workflow access across handler threads; sniffs
         # and reports acquisitions stuck past DEADLOCK_TIME.
         self._lock = SniffedLock(name="master.workflow_lock")
-        self._slaves = {}
+        self._slaves = {}  # guarded-by: _lock
         #: Departed workers' final descriptors (jobs_done/jobs_per_
         #: second), kept for the exit throughput report — EVERY
         #: disconnect (graceful bye included) removes the live entry,
@@ -181,11 +181,11 @@ class Server(Logger):
         #: (oldest evicted): every reconnect mints a fresh sid, so an
         #: elastic master under worker churn would otherwise leak one
         #: descriptor per departed session.
-        self._retired_slaves = {}
+        self._retired_slaves = {}  # guarded-by: _lock
         self._max_retired = int(kwargs.get("max_retired", 64))
-        self._slave_seq = 0
+        self._slave_seq = 0  # guarded-by: _lock
         #: Round-robin shard-rank assignment for --net-zero sessions.
-        self._zero_seq = 0
+        self._zero_seq = 0  # guarded-by: _lock
         self._stop = threading.Event()
         self.on_stopped = kwargs.get("on_stopped")
         #: Frames are HMAC-authenticated before unpickling.  Key
@@ -199,12 +199,12 @@ class Server(Logger):
             os.environ.get("VELES_NETWORK_SECRET") or
             workflow.checksum)
         #: jobs handed out but not yet answered, per slave id
-        self._outstanding = {}
+        self._outstanding = {}  # guarded-by: _lock
         #: Fault injector (resilience.FaultInjector) consulted at the
         #: ``master.crash`` point; None falls back to the process-wide
         #: one (``--chaos`` plan).
         self.injector = kwargs.get("injector")
-        self._crashed = False
+        self._crashed = False  # guarded-by: _chan_lock
         #: First master-side exception raised while serving a worker
         #: (None = clean).  Launcher.run re-raises it so the process
         #: exits NONZERO — a degraded coordinator must never write a
@@ -214,13 +214,13 @@ class Server(Logger):
         #: abruptly, exactly like a process death would.  Guarded by
         #: ``_chan_lock``: crash() must also catch a channel whose
         #: handler registered it concurrently.
-        self._channels = set()
+        self._channels = set()  # guarded-by: _chan_lock
         self._chan_lock = threading.Lock()
         #: Respawn hook: ``respawn(desc)`` relaunches a dropped
         #: worker (reference: server.py:637-655).
         self.respawn = kwargs.get("respawn")
         self.max_respawns = int(kwargs.get("max_respawns", 10))
-        self._respawn_counts = {}
+        self._respawn_counts = {}  # guarded-by: _lock
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name="veles-server-accept")
@@ -240,7 +240,7 @@ class Server(Logger):
             "blacklist_cooldown",
             config_get(root.common.server.blacklist_cooldown, 60.0)))
         #: machine id -> wall time of its latest blacklisting.
-        self._blacklist = {}
+        self._blacklist = {}  # guarded-by: _lock
         self._watchdog_thread = threading.Thread(
             target=self._watchdog_loop, daemon=True,
             name="veles-server-watchdog")
@@ -257,11 +257,14 @@ class Server(Logger):
     def stop(self):
         if self._stop.is_set():
             return
-        self._stop.set()
+        # Close the listen socket BEFORE signaling waiters: a
+        # supervisor that rebinds the same port the moment wait()
+        # returns must never race our own still-bound fd.
         try:
             self._sock.close()
         except OSError:
             pass
+        self._stop.set()
         if self.on_stopped is not None:
             self.on_stopped()
 
@@ -291,11 +294,13 @@ class Server(Logger):
             chans = list(self._channels)
         self.warning("injected coordinator crash — dying abruptly")
         resilience.stats.incr("master.crash")
-        self._stop.set()
+        # Socket first, stop-event second — see stop(): wait()
+        # returning is the restart supervisor's cue to rebind.
         try:
             self._sock.close()
         except OSError:
             pass
+        self._stop.set()
         for chan in chans:
             chan.close()
 
@@ -788,12 +793,19 @@ class Server(Logger):
         if self.respawn is None or self._stop.is_set():
             return
         mid = desc.mid or "unknown"
-        count = self._respawn_counts.get(mid, 0)
-        if count >= self.max_respawns:
+        # Concurrent drops (one handler thread per worker) race this
+        # counter — claim the respawn slot under the lock.
+        with self._lock:
+            count = self._respawn_counts.get(mid, 0)
+            if count >= self.max_respawns:
+                give_up = True
+            else:
+                give_up = False
+                self._respawn_counts[mid] = count + 1
+        if give_up:
             self.warning("worker machine %s exceeded %d respawns — "
                          "giving up on it", mid, self.max_respawns)
             return
-        self._respawn_counts[mid] = count + 1
         delay = min(2.0 ** count * 0.5, 30.0)
 
         def relaunch():

@@ -151,6 +151,10 @@ class CompileCache(object):
                 self.hits += 1
                 return self._entries[key]
             self.misses += 1
+        # Compile sentinel (analysis.runtime.strict_step): a MISS in
+        # a wrapped steady-state decode loop is a bucket-key bug.
+        from ..analysis import runtime as _art
+        _art.note_compile("serving:%r" % (key,))
         value = builder()
         with self._lock:
             if key in self._entries:

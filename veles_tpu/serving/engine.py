@@ -40,6 +40,7 @@ import time
 import numpy
 
 from .. import resilience
+from ..distributable import SniffedLock
 from ..error import Bug
 from ..logger import Logger
 from ..resilience import Deadline
@@ -147,24 +148,33 @@ class ServingEngine(Logger):
         #: gauge on /stats, /metrics, and the web-status serving row.
         self.weight_version = int(getattr(model, "weight_version",
                                           None) or 1)
-        self._pending = collections.deque()     # classify + dense gen
-        self._paged_wait = collections.deque()  # awaiting adoption
-        self._rows = []                         # active decode rows
-        self._kv_committed = 0                  # blocks reserved
-        self._cond = threading.Condition()
+        # The engine condition rides a SniffedLock so stuck
+        # acquisitions self-report and the analysis.runtime
+        # lock-order recorder sees serving's locks too.
+        self._cond = threading.Condition(
+            SniffedLock(name="ServingEngine.cond"))
+        self._pending = collections.deque()     # guarded-by: _cond
+        self._paged_wait = collections.deque()  # guarded-by: _cond
+        self._rows = []                         # guarded-by: _cond
+        self._kv_committed = 0                  # guarded-by: _cond
         self._thread = None
-        self._stopped = False
-        self._draining = False
-        self._breaker = "closed"   # closed | rebuilding | tripped
-        self._rebuilds = collections.deque()  # rebuild timestamps
-        self._ops = collections.deque()       # device-thread ops
-        self._reload_waiting = False          # full swap quiescing
+        self._stopped = False                   # guarded-by: _cond
+        self._draining = False                  # guarded-by: _cond
+        # closed | rebuilding | tripped
+        self._breaker = "closed"                # guarded-by: _cond
+        # rebuild timestamps
+        self._rebuilds = collections.deque()    # guarded-by: _cond
+        # device-thread ops
+        self._ops = collections.deque()         # guarded-by: _cond
+        # full swap quiescing
+        self._reload_waiting = False            # guarded-by: _cond
         #: Device thread mid-iteration (a taken batch or an adoption
         #: whose rows are not yet in ``_rows``): drain and quiesce
         #: must wait on this too, or work in the adoption window
         #: would be invisible to them and die at the hard stop.
-        self._busy = False
-        self._batch_ewma = {}  # kind -> recent device-batch cost
+        self._busy = False                      # guarded-by: _cond
+        # kind -> recent device-batch cost
+        self._batch_ewma = {}                   # guarded-by: _cond
 
     def _adopt_model(self, model, policy=None):
         """Binds ``model`` as the served model: caches its geometry
@@ -219,8 +229,9 @@ class ServingEngine(Logger):
         if self._thread is not None:
             return self
         self._ensure_pool()
-        self._stopped = False
-        self._draining = False
+        with self._cond:
+            self._stopped = False
+            self._draining = False
         self.stats.set_gauge("weight_version", self.weight_version)
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -304,8 +315,9 @@ class ServingEngine(Logger):
                 "restarted replica",
                 retry_after=self.RESTART_RETRY_AFTER)
         # Unblock any reload waiting on the device thread.
-        while self._ops:
-            op = self._ops.popleft()
+        with self._cond:
+            ops, self._ops = list(self._ops), collections.deque()
+        for op in ops:
             op["error"] = EngineStopped("serving engine stopped")
             op["event"].set()
 
@@ -535,8 +547,9 @@ class ServingEngine(Logger):
                 "request budget")
         if req.error is not None:
             raise req.error
-        self.stats.observe_request(
-            req.kind, time.monotonic() - req.t_submit)
+        self.stats.observe_request(  # lint-ok: VL301 req.kind is
+            req.kind, time.monotonic() - req.t_submit)  # set from
+        # the "classify"/"generate" literals at construction only
         return req.result
 
     def submit_classify(self, x, deadline=None):
@@ -805,8 +818,9 @@ class ServingEngine(Logger):
             else:
                 self._run_generate(live)
             dt = time.monotonic() - t0
-            self.stats.observe_batch(
+            self.stats.observe_batch(  # lint-ok: VL301 kind is a
                 live[0].kind, sum(r.rows for r in live), dt)
+            # construction-time literal ("classify"/"generate")
             self._note_ewma(live[0].kind, dt)
         except Exception as e:
             for req in live:
@@ -817,9 +831,10 @@ class ServingEngine(Logger):
                 req.event.set()
 
     def _note_ewma(self, kind, dt):
-        ewma = self._batch_ewma.get(kind)
-        self._batch_ewma[kind] = dt if ewma is None \
-            else 0.8 * ewma + 0.2 * dt
+        with self._cond:
+            ewma = self._batch_ewma.get(kind)
+            self._batch_ewma[kind] = dt if ewma is None \
+                else 0.8 * ewma + 0.2 * dt
 
     def _run_classify(self, live):
         x = numpy.concatenate([r.x for r in live], axis=0)

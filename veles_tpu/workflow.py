@@ -278,7 +278,14 @@ class Workflow(Container):
         for unit in self._units:
             if unit is self:
                 continue
-            piece = unit.generate_data_for_slave(slave)
+            # The unit's deadlock-sniffing data lock guards its
+            # distributed state against the other control-plane
+            # threads (snapshotter, serving, watchdog) — the
+            # reference's ``_data_threadsafe`` wrapper
+            # (distributable.py:139-157), applied at the aggregation
+            # point instead of per-method decorators.
+            with unit.data_threadsafe():
+                piece = unit.generate_data_for_slave(slave)
             if piece is not None:
                 data[unit.name] = piece
         return data
@@ -290,7 +297,8 @@ class Workflow(Container):
         for unit in self._units:
             if unit is self or not unit.negotiates_on_connect:
                 continue
-            piece = unit.generate_data_for_slave(slave)
+            with unit.data_threadsafe():
+                piece = unit.generate_data_for_slave(slave)
             if piece is not None:
                 data[unit.name] = piece
         return data
@@ -300,7 +308,9 @@ class Workflow(Container):
             if unit is self:
                 continue
             if data and unit.name in data:
-                unit.apply_data_from_slave(data[unit.name], slave)
+                with unit.data_threadsafe():
+                    unit.apply_data_from_slave(data[unit.name],
+                                               slave)
         if self.is_main:
             # One version bump per applied worker update (delta-sync
             # staleness bookkeeping; nested workflows defer to the
@@ -312,7 +322,8 @@ class Workflow(Container):
             if unit is self:
                 continue
             if data and unit.name in data:
-                unit.apply_data_from_master(data[unit.name])
+                with unit.data_threadsafe():
+                    unit.apply_data_from_master(data[unit.name])
 
     def apply_update_from_master(self, update):
         self.apply_data_from_master(update)

@@ -141,6 +141,7 @@ class KohonenTrainer(ForwardBase):
             h = h * m[:, None]
             denom = jnp.maximum(m.sum(), 1.0)
         else:
+            # lint-ok: VL101 static batch dim, a Python int
             denom = float(x.shape[0])
         # ½·Σ h·‖x−w‖² via the MXU-friendly expansion (no (B,N,D)
         # tensor materialized; ∂/∂w gives the Kohonen update).

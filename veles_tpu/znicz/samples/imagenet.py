@@ -209,8 +209,11 @@ class StreamedImagenetLoader(StreamLoader):
                 with numpy.load(cache) as z:
                     if numpy.array_equal(z["key"], key):
                         return z["mean"], z["rdisp"]
-            except Exception:
-                pass  # corrupt cache → recompute
+            except Exception as e:
+                import logging
+                logging.getLogger("imagenet").warning(
+                    "corrupt normalization cache %s (%s) — "
+                    "recomputing", cache, e)
         mean, rdisp = analyze_mean_disp(train)
         try:
             numpy.savez(cache + ".tmp.npz", key=key, mean=mean,
