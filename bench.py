@@ -76,7 +76,8 @@ A100_MLP_IMG_PER_SEC = 1.5e6
 #: exist here or in a real parser.
 BENCH_FLAGS = ("--mlp", "--lm", "--lm-toy", "--serve", "--streamed",
                "--streamed-jpeg", "--attn-stages", "--serve-streams",
-               "--serve-seconds", "--trace-out", "--optimizer")
+               "--serve-seconds", "--trace-out", "--optimizer",
+               "--pp-schedule", "--moe-topk", "--moe-experts")
 
 # Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
 # or blocks gain <3% more.  The perf levers that got here: banded-
@@ -514,7 +515,8 @@ def apply_attn_stages(stages):
 
 def build_lm(vocab=LM_VOCAB, seq=LM_SEQ, embed=LM_EMBED,
              heads=LM_HEADS, blocks=LM_BLOCKS, batch=LM_BATCH,
-             n_train=LM_N_TRAIN, n_valid=LM_N_VALID, remat=True):
+             n_train=LM_N_TRAIN, n_valid=LM_N_VALID, remat=True,
+             n_experts=0, top_k=None):
     import numpy
     import veles_tpu.prng as prng
     from veles_tpu.config import root
@@ -539,6 +541,7 @@ def build_lm(vocab=LM_VOCAB, seq=LM_SEQ, embed=LM_EMBED,
     wf = TinyLMWorkflow(
         launcher, vocab_size=vocab, seq_len=seq,
         embed_dim=embed, n_heads=heads, n_blocks=blocks,
+        n_experts=n_experts, top_k=top_k,
         minibatch_size=batch,
         ticks_per_dispatch=LM_TICKS_PER_DISPATCH,
         max_epochs=1000, loader_cls=SyntheticCorpus,
@@ -779,6 +782,160 @@ def trace_one_step(wf, path):
     return round(spans[-1]["dur"] / 1000.0, 3)
 
 
+#: Pipeline-schedule A/B geometry (``--pp-schedule``; docs/
+#: pipeline.md, BENCHNOTES): 4 stages × 8 microbatches of 8 layers —
+#: the ≥4-stage case ISSUE 12 asks the bubble measurement for.
+PP_STAGES = 4
+PP_MICRO = 8
+PP_LAYERS = 8
+PP_WIDTH = 256
+PP_MB_ROWS = 8
+
+
+def parse_moe(argv):
+    """``--moe-topk=K`` (and optional ``--moe-experts=E``, default 8
+    when top-k is set) → the LM bench builds its blocks as top-k MoE
+    instead of dense; returns (top_k, n_experts) — (None, 0) when
+    absent."""
+    topk = experts = None
+    for arg in argv:
+        if arg.startswith("--moe-topk="):
+            topk = int(arg.split("=", 1)[1])
+        if arg.startswith("--moe-experts="):
+            experts = int(arg.split("=", 1)[1])
+    if topk is None and experts is None:
+        return None, 0
+    return (topk or 1), (experts or 8)
+
+
+def moe_fields(wf, topk, n_experts):
+    """MoE columns for the bench JSON line: the configured routing
+    plus the run's accumulated router health (mean aux per tick and
+    the worst expert-load share) straight from the blocks'
+    ``moe_acc`` rows.  The bench loop drives only the loader, so the
+    Decision never drains the accumulator here — but if a future
+    bench mode runs the full workflow graph, fall back to the last
+    DecisionGD-published epoch stats (attribution.moe_summary)."""
+    blocks = [u for u in getattr(wf, "forwards", ())
+              if hasattr(u, "read_moe_acc")]
+    if not blocks:
+        return {}
+    from veles_tpu.loader.base import TRAIN
+    aux = ticks = 0.0
+    max_share = 0.0
+    for blk in blocks:
+        row = blk.read_moe_acc(TRAIN)
+        aux += float(row[0])
+        ticks += float(row[1])
+        load = row[2:]
+        max_share = max(max_share,
+                        float(load.max()) / max(float(load.sum()),
+                                                1.0))
+    if not ticks:
+        from veles_tpu.observability import attribution
+        summary = attribution.moe_summary()
+        if summary:
+            aux, ticks = summary["aux_loss"], 1.0
+            max_share = summary["max_load_frac"]
+    return {"moe_topk": topk, "moe_experts": n_experts,
+            "moe_aux_loss": round(aux / max(ticks, 1.0), 4),
+            "moe_max_load_frac": round(max_share, 4)}
+
+
+def pipeline_bench(argv):
+    """``--pp-schedule[=gpipe,1f1b,interleaved]`` — the pipeline
+    schedule A/B micro-bench (BENCHNOTES; docs/pipeline.md): one
+    jitted fwd+bwd through ops.pipeline per schedule at
+    PP_STAGES×PP_MICRO, reporting table-derived scan steps and
+    bubble fractions plus measured wall ms, and the 1F1B
+    matched-memory headline — GPipe at 1F1B's S-microbatch
+    activation budget must flush every S microbatches (two M=S
+    ramps here) while 1F1B runs the full M in one; ``value`` is the
+    measured flushed-GPipe/1F1B wall ratio."""
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    from veles_tpu.ops.pipeline import (SCHEDULES, bubble_fraction,
+                                        pipeline, schedule_steps)
+    from veles_tpu.parallel import make_mesh
+    spec = next((a.split("=", 1)[1] for a in argv
+                 if a.startswith("--pp-schedule=")), "")
+    names = tuple(s for s in spec.split(",") if s) or SCHEDULES
+    for s in names:
+        if s not in SCHEDULES:
+            raise SystemExit("unknown pipeline schedule %r — valid: "
+                             "%s" % (s, ", ".join(SCHEDULES)))
+    S, M, L, F = PP_STAGES, PP_MICRO, PP_LAYERS, PP_WIDTH
+    V = max(1, L // S)
+    rng = numpy.random.RandomState(0)
+    params = {
+        "w": rng.normal(0, 0.2, (L, F, F)).astype(numpy.float32),
+        "b": rng.normal(0, 0.1, (L, F)).astype(numpy.float32)}
+    x = rng.normal(0, 1, (M * PP_MB_ROWS, F)).astype(numpy.float32)
+
+    def fn(p, h):
+        return jnp.tanh(h @ p["w"] + p["b"])
+
+    mesh = make_mesh(axes={"stage": S})
+
+    def timed_grad(xs, micro, schedule, repeats=5):
+        f = jax.jit(jax.grad(lambda p: (pipeline(
+            fn, p, jnp.asarray(xs), mesh, "stage", micro,
+            schedule=schedule) ** 2).sum()))
+
+        def sync(g):
+            numpy.array(jax.device_get(g["b"].ravel()[0]))
+
+        sync(f(params))  # compile
+        t0 = time.time()
+        for _ in range(repeats):
+            out = f(params)
+        sync(out)
+        return (time.time() - t0) / repeats * 1e3
+
+    schedules = {}
+    for name in names:
+        chunks = V if name == "interleaved" else 1
+        steps = len(schedule_steps(name, S, M, n_chunks=chunks))
+        schedules[name] = {
+            "scan_steps": steps,
+            # Interleaved steps cost 1/V of a stage step — the
+            # comparable unit across schedules.
+            "weighted_steps": round(steps / float(chunks), 2),
+            "bubble_frac": round(bubble_fraction(
+                name, S, M, n_chunks=chunks), 4),
+            "chunks": chunks,
+            "fwd_bwd_wall_ms": round(timed_grad(x, M, name), 3),
+        }
+    out = {
+        "metric": "pipeline_schedule_ab",
+        "unit": "x_vs_memory_matched_gpipe",
+        "stages": S, "microbatches": M, "layers": L,
+        "schedules": schedules,
+    }
+    if "1f1b" in schedules:
+        # Matched activation memory: GPipe flushes every S
+        # microbatches (M/S ramps of M=S), 1F1B runs M unflushed.
+        # Each flush covers its SLICE of the batch at the SAME
+        # microbatch size (S·rows of the M-run's per-microbatch
+        # rows), so total compute — and per-step activation memory —
+        # match the 1F1B run; only the schedule differs.
+        flushes = M // S
+        flushed_steps = flushes * (S + S - 1)
+        flushed_ms = timed_grad(x[:S * PP_MB_ROWS], S,
+                                "gpipe") * flushes
+        out["gpipe_flushed_scan_steps"] = flushed_steps
+        out["gpipe_flushed_bubble_frac"] = round(
+            bubble_fraction("gpipe", S, S), 4)
+        out["gpipe_flushed_wall_ms"] = round(flushed_ms, 3)
+        out["value"] = round(
+            flushed_ms / schedules["1f1b"]["fwd_bwd_wall_ms"], 4)
+        out["vs_baseline"] = out["value"]
+        out["vs_baseline_meaning"] = \
+            "memory_matched_gpipe_over_1f1b_wall"
+    print(json.dumps(out))
+
+
 def parse_optimizer(argv):
     """``--optimizer=adam`` → sets the engine default so every GD
     unit of the benched workflow uses the named rule (sgd default);
@@ -865,6 +1022,11 @@ def attribution_fields():
 
 
 def main():
+    if any(a.startswith("--pp-schedule") for a in sys.argv):
+        # The pipeline schedule A/B micro-bench is its own mode
+        # (the LM headline bench is dense/non-pipelined).
+        pipeline_bench(sys.argv)
+        return
     if "--serve" in sys.argv:
         serve_bench(sys.argv)
         return
@@ -936,6 +1098,9 @@ def main():
         stages = parse_attn_stages(sys.argv)
         apply_attn_stages(stages)
         opt_name = parse_optimizer(sys.argv)
+        # --moe-topk=K [--moe-experts=E]: the LM's blocks become
+        # top-k MoE; router health rides the JSON line (moe_fields).
+        moe_topk, moe_experts = parse_moe(sys.argv)
         # MFU denominator for the live attribution gauge: the same
         # v5e peak the analytic MFU below uses, so the two numbers
         # are directly comparable on the JSON line.
@@ -948,7 +1113,8 @@ def main():
                         blocks=LM_TOY_BLOCKS, batch=LM_TOY_BATCH,
                         n_train=LM_TOY_N_TRAIN,
                         n_valid=LM_TOY_N_VALID, remat=False)
-            _, wf = build_lm(**geom)
+            _, wf = build_lm(n_experts=moe_experts, top_k=moe_topk,
+                             **geom)
         else:
             # The default geometry lives ONCE in build_lm's defaults
             # (the LM_* constants); geom here only feeds the FLOP
@@ -956,7 +1122,7 @@ def main():
             geom = dict(vocab=LM_VOCAB, seq=LM_SEQ, embed=LM_EMBED,
                         blocks=LM_BLOCKS, n_train=LM_N_TRAIN,
                         n_valid=LM_N_VALID)
-            _, wf = build_lm()
+            _, wf = build_lm(n_experts=moe_experts, top_k=moe_topk)
         ips = measure(wf, epochs=2)
         trace_out = next(
             (a.split("=", 1)[1] for a in sys.argv
@@ -994,6 +1160,7 @@ def main():
             "trace_out": trace_out,
             **attribution_fields(),
             **optimizer_fields(wf, opt_name),
+            **moe_fields(wf, moe_topk, moe_experts),
         }))
         return
     if "--mlp" in sys.argv:

@@ -37,7 +37,7 @@ CHECKED_PREFIXES = frozenset((
     "net", "chaos", "server", "client", "master", "worker",
     "snapshot", "step", "serving", "guardian", "device", "kv",
     "requests", "batches", "tokens", "rejected", "cancelled",
-    "stalled", "warmup", "ttft", "itl", "perf", "optimizer",
+    "stalled", "warmup", "ttft", "itl", "perf", "optimizer", "moe",
 ))
 
 

@@ -78,6 +78,38 @@ def test_vl101_traced_method_convention(tmp_path):
     assert _rules(findings) == {"VL101"}
 
 
+def test_vl101_shard_map_closures_are_entries(tmp_path):
+    """shard_map-wrapped functions (the pipeline schedule closures,
+    ISSUE 12) are traced entry points — hazards inside them and in
+    their nested scan bodies are caught, from BOTH import forms."""
+    findings = _lint(tmp_path, """
+        import numpy
+        from jax.experimental.shard_map import shard_map
+
+        def pipelined(params, x, mesh):
+            def stage_fn(p, h):
+                def body(carry, t):
+                    return carry + numpy.asarray(t), None
+                return body(p, h)[0].item()
+            return shard_map(stage_fn, mesh=mesh)(params, x)
+        """)
+    hits = [f for f in findings if f.rule == "VL101"]
+    assert hits and _rules(findings) == {"VL101"}, findings
+    assert any("asarray" in f.message for f in hits)
+    assert any("item" in f.message for f in hits)
+    assert all("stage_fn" in f.message for f in hits)
+    findings = _lint(tmp_path, """
+        import time
+        from jax import shard_map
+
+        def run(params, x, mesh):
+            def stage_fn(p, h):
+                return p * time.time()
+            return shard_map(stage_fn, mesh=mesh)(params, x)
+        """, name="jaxform.py")
+    assert _rules(findings) == {"VL102"}, findings
+
+
 def test_vl101_host_code_not_flagged(tmp_path):
     """The builder around a jitted closure is host code — its numpy
     calls are legitimate and must NOT be flagged."""

@@ -175,6 +175,8 @@ CONTRIBUTING_MODULES = (
     "veles_tpu.network_common",
     "veles_tpu.observability",
     "veles_tpu.ops.attention",
+    "veles_tpu.ops.moe",
+    "veles_tpu.ops.pipeline",
     "veles_tpu.restful",
     "veles_tpu.snapshotter",
     "veles_tpu.znicz.optimizers",

@@ -1,5 +1,5 @@
-"""Mixture-of-Experts dispatch (GShard-style top-1 routing with
-capacity) — the expert-parallel building block.
+"""Mixture-of-Experts dispatch (GShard/Switch-style top-k routing
+with capacity) — the expert-parallel building block.
 
 Not in the 2013-15 reference (SURVEY §5); part of the TPU build's
 first-class scaling matrix (dp/tp/sp/ep).  The formulation is the
@@ -11,10 +11,35 @@ an ``expert`` axis the expert dimension of the parameters and of the
 dispatched activations shards there — XLA lowers the dispatch/combine
 einsums to all-to-alls over ICI, exactly the manual A2A of expert-
 parallel frameworks, without hand-written collectives.
+
+Routing (ISSUE 12): :func:`top1_routing` is the historical GShard
+top-1 path, kept verbatim — seeded trajectories depend on its exact
+bits; :func:`topk_routing` generalizes it to k ≥ 2 choices per token
+with rank-major capacity priority (all first choices queue before
+any second choice), renormalized gates, the Switch load-balance
+auxiliary (eq. 4) and the ST-MoE router z-loss.  Capacity scales
+with k: ``C = capacity_factor · k · T / E``.
 """
 
 import jax
 import jax.numpy as jnp
+
+
+def init_parser(parser):
+    """MoE routing flags, aggregated into the velescli parser
+    (handed to ``root.common.engine`` by
+    ``__main__.apply_subsystem_flags``)."""
+    parser.add_argument(
+        "--moe-topk", type=int, default=None, metavar="K",
+        help="Mixture-of-Experts router: experts per token (default "
+             "1 = the Switch/GShard top-1 path; k>=2 dispatches each "
+             "token to its k best experts with rank-major capacity "
+             "priority and renormalized gates) (docs/moe.md)")
+    parser.add_argument(
+        "--moe-router-z", type=float, default=None, metavar="W",
+        help="router z-loss weight (ST-MoE): penalizes "
+             "mean(logsumexp(router logits)^2) to keep router "
+             "logits small/stable; 0 (default) disables the term")
 
 
 def top1_routing(logits, capacity):
@@ -54,22 +79,101 @@ def top1_routing(logits, capacity):
     return dispatch, combine, aux_loss, onehot.sum(axis=0)
 
 
-def moe_ffn(x, router_w, w1, b1, w2, b2, capacity_factor=1.25):
-    """Top-1 MoE feed-forward over tokens.
+def topk_routing(logits, k, capacity):
+    """Top-k router (GShard/Switch): per-token k expert choices with
+    a per-expert capacity limit and rank-major queue priority —
+    every token's FIRST choice queues before any token's second.
+
+    Args:
+      logits: (T, E) router scores; k: choices per token (k <= E);
+      capacity: int — max tokens an expert accepts per rank-merged
+        queue; overflow assignments are DROPPED (combine weight zero
+        → the residual path carries them).
+
+    Returns:
+      dispatch: (T, E, C) 0/1 — token t occupies slot c of expert e
+        through any of its k choices;
+      combine:  (T, E, C) float — dispatch · renormalized gate
+        (k = 1 keeps the raw top probability, matching
+        :func:`top1_routing`'s Switch convention);
+      aux_loss: Switch load-balance auxiliary (eq. 4) over the
+        rank-0 choices: mean_e f_e · p_e · E;
+      z_loss:   ST-MoE router z-loss, mean(logsumexp(logits)²);
+      expert_load: (E,) assignments per expert over all k ranks,
+        pre-capacity.
+    """
+    T, E = logits.shape
+    if not 1 <= k <= E:
+        raise ValueError("top_k=%d must satisfy 1 <= k <= %d experts"
+                         % (k, E))
+    logits = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, expert = jax.lax.top_k(probs, k)          # (T, k)
+    if k > 1:
+        # Renormalize the selected gates (GShard top-2 convention);
+        # k = 1 keeps the raw probability so the top-1 path's bits
+        # are reproducible through this function too.
+        gate = gate / jnp.maximum(gate.sum(axis=-1, keepdims=True),
+                                  1e-9)
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # (T,k,E)
+    # Queue positions over the RANK-MAJOR flattening: all rank-0
+    # choices first, so capacity overflow drops low-rank assignments
+    # before anyone's primary expert.
+    flat = onehot.transpose(1, 0, 2).reshape(k * T, E)
+    position = (jnp.cumsum(flat, axis=0) - 1.0) * flat
+    keep = (position < capacity) * flat             # (k·T, E)
+    slot = position.sum(axis=-1).astype(jnp.int32)
+    disp = (keep[:, :, None] * jax.nn.one_hot(
+        slot, capacity, dtype=jnp.float32)[:, None, :]).reshape(
+        k, T, E, capacity)
+    dispatch = disp.sum(axis=0)
+    combine = (disp * gate.T[:, :, None, None]).sum(axis=0)
+    # Switch load-balance aux (eq. 4): fraction of rank-0 choices
+    # per expert × mean router probability, scaled by E.
+    f = onehot[:, 0, :].mean(axis=0)
+    p = probs.mean(axis=0)
+    aux_loss = (f * p).sum() * E
+    z_loss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    return dispatch, combine, aux_loss, z_loss, onehot.sum(
+        axis=(0, 1))
+
+
+def moe_capacity(capacity_factor, n_tokens, n_experts, top_k=1):
+    """The per-expert slot count: ``capacity_factor · k · T / E``,
+    floored at 1 — a compile-time Python int (shapes depend on it)."""
+    # lint-ok: VL101 static shape math — T/E/k are Python ints, the
+    # capacity is a compile-time constant, never a traced value.
+    return max(1, int(capacity_factor * top_k * n_tokens /
+                      n_experts))
+
+
+def moe_ffn_topk(x, router_w, w1, b1, w2, b2, capacity_factor=1.25,
+                 top_k=1):
+    """Top-k MoE feed-forward over tokens.
 
     Args:
       x: (T, D) tokens; router_w: (D, E);
-      w1: (E, D, H); b1: (E, H); w2: (E, H, D); b2: (E, D).
+      w1: (E, D, H); b1: (E, H); w2: (E, H, D); b2: (E, D);
+      top_k: experts per token (1 = the historical top-1 path,
+        bit-identical to the pre-top-k :func:`moe_ffn`).
 
-    Returns (y (T, D), aux_loss, expert_load (E,)).
+    Returns (y (T, D), aux_loss, z_loss, expert_load (E,)) — the
+    load-balance aux and the router z-loss ride back SEPARATELY so
+    the caller weights them independently.
     """
     T, D = x.shape
     E = router_w.shape[1]
-    # lint-ok: VL101 static shape math — T/E are Python ints, the
-    # capacity is a compile-time constant, never a traced value.
-    capacity = max(1, int(capacity_factor * T / E))
+    capacity = moe_capacity(capacity_factor, T, E, top_k)
     logits = x.astype(jnp.float32) @ router_w
-    dispatch, combine, aux, load = top1_routing(logits, capacity)
+    if top_k == 1:
+        # The pre-top-k code path, bit-for-bit (seeded MoE
+        # trajectories are pinned on it); z computed on the side.
+        dispatch, combine, aux, load = top1_routing(logits, capacity)
+        z = jnp.mean(jax.nn.logsumexp(logits.astype(jnp.float32),
+                                      axis=-1) ** 2)
+    else:
+        dispatch, combine, aux, z, load = topk_routing(
+            logits, top_k, capacity)
     # Gather each expert's tokens: (E, C, D).
     expert_in = jnp.einsum("tec,td->ecd", dispatch,
                            x.astype(jnp.float32),
@@ -83,4 +187,17 @@ def moe_ffn(x, router_w, w1, b1, w2, b2, capacity_factor=1.25):
     # Scatter back with gate weighting: dropped tokens get zeros.
     y = jnp.einsum("tec,ecd->td", combine, expert_out,
                    preferred_element_type=jnp.float32)
+    return y, aux, z, load
+
+
+def moe_ffn(x, router_w, w1, b1, w2, b2, capacity_factor=1.25,
+            top_k=1, router_z_weight=0.0):
+    """Compatibility wrapper over :func:`moe_ffn_topk`: returns
+    (y, aux, load) with ``router_z_weight·z_loss`` folded into the
+    auxiliary (0 keeps the historical top-1 bits exactly)."""
+    y, aux, z, load = moe_ffn_topk(
+        x, router_w, w1, b1, w2, b2,
+        capacity_factor=capacity_factor, top_k=top_k)
+    if router_z_weight:
+        aux = aux + router_z_weight * z
     return y, aux, load

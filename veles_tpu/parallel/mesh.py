@@ -375,41 +375,16 @@ def apply_dp_ep_sharding(workflow, mesh, data_axis="data",
     stay replicated (correct, merely not expert-parallel).
     """
     apply_dp_sharding(workflow, mesh, axis=data_axis)
-    n_exp = mesh.shape[expert_axis]
-    gd_of = {gd.target: gd
-             for gd in getattr(workflow, "gds", [])
-             if getattr(gd, "target", None) is not None}
-    sharded_blocks = 0
-    for unit in getattr(workflow, "forwards", []):
-        expert_params = getattr(unit, "expert_params", None)
-        if expert_params is None:
-            continue
-        if unit.n_experts % n_exp:
-            continue
-        for vec in expert_params.values():
-            ndim = len(vec.shape)
-            spec = PartitionSpec(expert_axis,
-                                 *([None] * (ndim - 1)))
-            vec.sharding = NamedSharding(mesh, spec)
-        sharded_blocks += 1
-        gd = gd_of.get(unit)
-        if gd is not None:
-            # Optimizer slots match their parameter BY NAME (any
-            # registered slot prefix — velocity_/adam_m_/…) — shape
-            # matching would mis-shard e.g. velocity_router when
-            # router (D, E) happens to collide with b2 (E, D).
-            from ..znicz.optimizers import param_of_slot
-            for name, vec in gd.tstate.items():
-                pname = param_of_slot(name) or name
-                target = expert_params.get(pname)
-                if vec and target is not None and \
-                        tuple(vec.shape) == tuple(target.shape):
-                    vec.sharding = target.sharding
-    if sharded_blocks == 0:
+    # Optimizer slots match their parameter BY NAME inside the
+    # shared overlay (any registered slot prefix — velocity_/
+    # adam_m_/…) — shape matching would mis-shard e.g.
+    # velocity_router when router (D, E) collides with b2 (E, D).
+    if _overlay_leading_axis(workflow, mesh, "expert_params",
+                             "n_experts", expert_axis) == 0:
         workflow.warning(
             "apply_dp_ep_sharding: no MoE block's n_experts divides "
             "the expert axis (%d) — the workflow runs data-parallel "
-            "only" % n_exp)
+            "only" % mesh.shape[expert_axis])
     workflow._parallel_style_ = ("dp_ep", data_axis, expert_axis)
     return workflow
 
@@ -429,39 +404,111 @@ def apply_dp_pp_sharding(workflow, mesh, data_axis="data",
     merely not pipelined).
     """
     apply_dp_sharding(workflow, mesh, axis=data_axis)
-    n_stage = mesh.shape[stage_axis]
-    gd_of = {gd.target: gd
-             for gd in getattr(workflow, "gds", [])
-             if getattr(gd, "target", None) is not None}
-    sharded_stacks = 0
-    for unit in getattr(workflow, "forwards", []):
-        stage_params = getattr(unit, "stage_params", None)
-        if stage_params is None:
-            continue
-        if unit.n_blocks % n_stage:
-            continue
-        for vec in stage_params.values():
-            spec = PartitionSpec(stage_axis,
-                                 *([None] * (len(vec.shape) - 1)))
-            vec.sharding = NamedSharding(mesh, spec)
-        sharded_stacks += 1
-        gd = gd_of.get(unit)
-        if gd is not None:
-            # By-name slot matching (any registered slot prefix), as
-            # in the expert helper.
-            from ..znicz.optimizers import param_of_slot
-            for name, vec in gd.tstate.items():
-                pname = param_of_slot(name) or name
-                target = stage_params.get(pname)
-                if vec and target is not None and \
-                        tuple(vec.shape) == tuple(target.shape):
-                    vec.sharding = target.sharding
-    if sharded_stacks == 0:
+    if _overlay_leading_axis(workflow, mesh, "stage_params",
+                             "n_blocks", stage_axis) == 0:
         workflow.warning(
             "apply_dp_pp_sharding: no pipelined stack's n_blocks "
             "divides the stage axis (%d) — the workflow runs "
-            "data-parallel only" % n_stage)
+            "data-parallel only" % mesh.shape[stage_axis])
     workflow._parallel_style_ = ("dp_pp", data_axis, stage_axis)
+    return workflow
+
+
+def _overlay_leading_axis(workflow, mesh, params_attr, count_attr,
+                          lead_axis):
+    """The shared ep/pp leading-dim overlay (used by the plain
+    dp×ep / dp×pp appliers AND the ×tp compositions): for every unit
+    exposing ``params_attr`` (stage_params / expert_params) whose
+    ``count_attr`` (n_blocks / n_experts) divides the ``lead_axis``
+    size, put ``lead_axis`` on dim 0 ON TOP of whatever trailing
+    axes are already assigned (all-None after plain dp, the Megatron
+    column/row pairing after :func:`apply_dp_tp_sharding`), then
+    re-point the mirroring optimizer slots by name
+    (``znicz.optimizers.param_of_slot`` — shape matching alone could
+    collide).  Returns the number of units overlaid."""
+    from ..znicz.optimizers import param_of_slot
+    n_lead = mesh.shape[lead_axis]
+    gd_of = {gd.target: gd
+             for gd in getattr(workflow, "gds", [])
+             if getattr(gd, "target", None) is not None}
+    overlaid = 0
+    for unit in getattr(workflow, "forwards", []):
+        stacked = getattr(unit, params_attr, None)
+        if stacked is None:
+            continue
+        if getattr(unit, count_attr) % n_lead:
+            continue
+        for vec in stacked.values():
+            cur = ()
+            if isinstance(vec.sharding, NamedSharding):
+                cur = tuple(vec.sharding.spec)
+            axes = list(cur) + [None] * (len(vec.shape) - len(cur))
+            axes[0] = lead_axis
+            vec.sharding = NamedSharding(mesh, PartitionSpec(*axes))
+        overlaid += 1
+        gd = gd_of.get(unit)
+        if gd is not None:
+            for name, vec in gd.tstate.items():
+                pname = param_of_slot(name) or name
+                target = stacked.get(pname)
+                if vec and target is not None and \
+                        tuple(vec.shape) == tuple(target.shape):
+                    vec.sharding = target.sharding
+    return overlaid
+
+
+def apply_dp_pp_tp_sharding(workflow, mesh, data_axis="data",
+                            stage_axis="stage", model_axis="model"):
+    """COMPOSED 3-axis layout: data × pipeline × tensor parallelism
+    (ISSUE 12).  :func:`apply_dp_tp_sharding` lays the Megatron
+    column/row pairing on every transformer unit — the pipelined
+    stack's plan deliberately leaves dim 0 alone — then the stage
+    axis overlays the stacks' leading dim, so each device stores
+    1/(pp·tp) of the stack.  Inside the step the stack runs its
+    ppermute schedule over ``stage_axis`` via shard_map whose
+    in_specs name only the stage axis: XLA re-gathers the model-dim
+    shards at pipeline entry (storage stays sharded; the embedding/
+    LM-head compute outside the stack is genuinely tensor-parallel).
+    ``dryrun_multichip`` self-verifies the composition against the
+    1-device step."""
+    apply_dp_tp_sharding(workflow, mesh, data_axis=data_axis,
+                         model_axis=model_axis)
+    n = _overlay_leading_axis(workflow, mesh, "stage_params",
+                              "n_blocks", stage_axis)
+    if n == 0:
+        workflow.warning(
+            "apply_dp_pp_tp_sharding: no pipelined stack's n_blocks "
+            "divides the stage axis (%d) — the workflow runs dp×tp "
+            "only" % mesh.shape[stage_axis])
+    workflow._parallel_style_ = ("dp_pp_tp", data_axis, stage_axis,
+                                 model_axis)
+    return workflow
+
+
+def apply_dp_ep_tp_sharding(workflow, mesh, data_axis="data",
+                            expert_axis="expert",
+                            model_axis="model"):
+    """COMPOSED 3-axis layout: data × expert × tensor parallelism
+    (ISSUE 12).  The Megatron trailing column/row pairing on each
+    expert's matrices comes from :func:`apply_dp_tp_sharding` (the
+    MoE plan shards w1/w2's TRAILING dims, leaving the expert dim
+    alone); the expert axis then overlays dim 0.  The GShard
+    dispatch/combine einsums are plain GSPMD — no shard_map — so
+    both axes propagate: XLA lowers the dispatch to all-to-alls over
+    the expert axis while each expert's FFN einsums keep the hidden
+    dim sharded over the model axis.  ``dryrun_multichip``
+    self-verifies the composition against the 1-device step."""
+    apply_dp_tp_sharding(workflow, mesh, data_axis=data_axis,
+                         model_axis=model_axis)
+    n = _overlay_leading_axis(workflow, mesh, "expert_params",
+                              "n_experts", expert_axis)
+    if n == 0:
+        workflow.warning(
+            "apply_dp_ep_tp_sharding: no MoE block's n_experts "
+            "divides the expert axis (%d) — the workflow runs dp×tp "
+            "only" % mesh.shape[expert_axis])
+    workflow._parallel_style_ = ("dp_ep_tp", data_axis, expert_axis,
+                                 model_axis)
     return workflow
 
 

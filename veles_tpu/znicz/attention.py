@@ -341,8 +341,20 @@ class MoETransformerBlock(TransformerBlock):
 
     kwargs beyond TransformerBlock: ``n_experts``;
     ``capacity_factor`` (default 1.25); ``aux_weight`` — load-balance
-    loss weight (default 0.01); ``expert_axis`` — recorded so the
-    sharding helper can find MoE blocks.
+    loss weight (default 0.01); ``top_k`` — experts per token
+    (default: ``root.common.engine.moe_top_k`` or 1 — the Switch/
+    GShard top-1 path; k ≥ 2 routes through ``ops.moe.topk_routing``
+    with rank-major capacity priority); ``router_z_weight`` — ST-MoE
+    router z-loss weight (default: ``root.common.engine.
+    moe_router_z`` or 0); ``expert_axis`` — recorded so the sharding
+    helper can find MoE blocks.
+
+    Router health rides the epoch accounting: ``moe_acc`` is a
+    (3 classes × 2 + n_experts) on-device accumulator —
+    [aux_sum, ticks, load_0 … load_{E−1}] — added to inside the
+    fused step and fetched by DecisionGD at epoch boundaries (the
+    ``moe.aux_loss`` / ``moe.expert_load`` gauges; router collapse
+    is visible live on the heartbeat perf section / web_status).
     """
 
     MAPPING = "moe_transformer_block"
@@ -356,8 +368,54 @@ class MoETransformerBlock(TransformerBlock):
         self.n_experts = kwargs.get("n_experts", 4)
         self.capacity_factor = kwargs.get("capacity_factor", 1.25)
         self.aux_weight = kwargs.get("aux_weight", 0.01)
+        top_k = kwargs.get("top_k")
+        if top_k is None:
+            top_k = config_get(root.common.engine.moe_top_k, 1)
+        self.top_k = int(top_k)
+        if not 1 <= self.top_k <= self.n_experts:
+            raise ValueError(
+                "top_k=%d must satisfy 1 <= k <= n_experts=%d"
+                % (self.top_k, self.n_experts))
+        z_weight = kwargs.get("router_z_weight")
+        if z_weight is None:
+            z_weight = config_get(root.common.engine.moe_router_z,
+                                  0.0)
+        self.router_z_weight = float(z_weight)
         self.expert_axis = kwargs.get("expert_axis")
+        #: Optional link to the loader's class vector — buckets the
+        #: moe_acc rows per sample class (TRAIN row when unlinked).
+        self.minibatch_class_vec = kwargs.get("minibatch_class_vec")
+        #: Optional link to the loader's mask — gates padded block
+        #: ticks (all-zero mask) out of the router-health row, the
+        #: same validity treatment the evaluator accumulator applies.
+        self.minibatch_mask = kwargs.get("minibatch_mask")
+        self.moe_acc = Vector()
         super(MoETransformerBlock, self).__init__(workflow, **kwargs)
+
+    @property
+    def tstate(self):
+        state = dict(super(MoETransformerBlock, self).tstate)
+        acc = getattr(self, "moe_acc", None)
+        if acc is None:  # block from a pre-top-k snapshot
+            acc = self.moe_acc = Vector()
+        if not acc:
+            acc.mem = numpy.zeros((3, 2 + self.n_experts),
+                                  dtype=numpy.float32)
+        state["moe_acc"] = acc
+        return state
+
+    def read_moe_acc(self, cls):
+        """Host fetch of one class's router row — [aux_sum, ticks,
+        per-expert load] (rides the Decision's epoch-boundary sync
+        like the evaluator accumulators)."""
+        acc = self.tstate["moe_acc"]
+        acc.map_read()
+        return numpy.array(acc.mem[cls])
+
+    def reset_moe_acc(self, cls):
+        acc = self.tstate["moe_acc"]
+        acc.map_write()
+        acc.mem[cls] = 0.0
 
     def initialize(self, device=None, **kwargs):
         batch, seq, embed = self.input.shape
@@ -378,6 +436,8 @@ class MoETransformerBlock(TransformerBlock):
                 self.rand().fill_normal(arr, stddev=stddev)
             vec.mem = arr
             vec.initialize(self.device)
+        acc = self.tstate["moe_acc"]  # allocates when absent
+        acc.initialize(device)
         super(MoETransformerBlock, self).initialize(device=device,
                                                     **kwargs)
 
@@ -389,23 +449,24 @@ class MoETransformerBlock(TransformerBlock):
 
     def tforward(self, read, write, params, ctx, state=None):
         import jax.numpy as jnp
-        from ..ops.moe import moe_ffn
+        from ..ops.moe import moe_ffn_topk
         x = read(self.input)
         B, S, E = x.shape
 
         def apply(p, h0):
-            """Pure (params, x) → (out, aux, load): the MoE side
+            """Pure (params, x) → (out, aux, z, load): the MoE side
             outputs RIDE the return value (not ctx closure mutation),
             so the whole block is checkpointable — a tracer born
             inside jax.checkpoint must not leak out through ctx."""
             box = {}
 
             def mlp(h):
-                y, aux, load = moe_ffn(
+                y, aux, z, load = moe_ffn_topk(
                     h.reshape(B * S, E), p["router"], p["w1"],
                     p["b1"], p["w2"], p["b2"],
-                    capacity_factor=self.capacity_factor)
-                box["aux"], box["load"] = aux, load
+                    capacity_factor=self.capacity_factor,
+                    top_k=getattr(self, "top_k", 1))
+                box["aux"], box["z"], box["load"] = aux, z, load
                 return y.reshape(B, S, E)
 
             out = transformer_block_apply(
@@ -413,16 +474,40 @@ class MoETransformerBlock(TransformerBlock):
                 self.compute_dtype,
                 attend=lambda q, k, v: self._attend(q, k, v),
                 mlp=mlp)
-            return out, box["aux"], box["load"]
+            return out, box["aux"], box["z"], box["load"]
 
         if remat_enabled(getattr(self, "remat", None)):
             import jax
             apply = jax.checkpoint(apply)
-        out, aux, load = apply(params, x)
-        ctx.add_aux_loss(self.aux_weight * aux)
+        out, aux, z, load = apply(params, x)
+        total_aux = self.aux_weight * aux
+        z_weight = getattr(self, "router_z_weight", 0.0)
+        if z_weight:
+            # Static-zero skip keeps the pre-z traced graph (and its
+            # seeded trajectories) bit-identical when disabled.
+            total_aux = total_aux + z_weight * z
+        ctx.add_aux_loss(total_aux)
         ctx.add_metric("%s_max_expert_load" % self.name,
                        load.max() / jnp.maximum(load.sum(), 1.0))
         write(self.output, out)
+        if state is not None and "moe_acc" in state:
+            # Router-health epoch row: aux + per-expert load bucketed
+            # by the minibatch class (TRAIN when no loader link) —
+            # fetched by DecisionGD with the epoch accumulators.
+            # Padded block ticks (all-zero mask) are gated out whole,
+            # like the evaluator's epoch row: filler dispatches must
+            # not dilute the mean aux or skew the load shares.
+            cvec = getattr(self, "minibatch_class_vec", None)
+            cls = read(cvec).astype(jnp.int32) if cvec is not None \
+                else jnp.int32(2)
+            mvec = getattr(self, "minibatch_mask", None)
+            valid = (read(mvec).sum() > 0).astype(jnp.float32) \
+                if mvec is not None else jnp.float32(1.0)
+            row = jnp.concatenate([
+                jnp.stack([aux.astype(jnp.float32),
+                           jnp.float32(1.0)]),
+                load.astype(jnp.float32)]) * valid
+            return {"moe_acc": state["moe_acc"].at[cls].add(row)}
 
 
 class PipelinedTransformerStack(ForwardBase):
@@ -447,6 +532,26 @@ class PipelinedTransformerStack(ForwardBase):
         self.causal = kwargs.get("causal", True)
         self.stage_axis = kwargs.get("stage_axis")
         self.n_microbatches = kwargs.get("n_microbatches", 4)
+        #: Pipeline schedule (ops/pipeline.py SCHEDULES): "gpipe"
+        #: fill-and-drain, "1f1b" PipeDream-flush memory class,
+        #: "interleaved" Megatron virtual chunks.  None → the
+        #: root.common.engine.pp_schedule knob (--pp-schedule).
+        schedule = kwargs.get("schedule")
+        if schedule is None:
+            schedule = config_get(root.common.engine.pp_schedule,
+                                  "gpipe")
+        from ..ops.pipeline import SCHEDULES
+        if schedule not in SCHEDULES:
+            raise ValueError("unknown pipeline schedule %r — valid: "
+                             "%s" % (schedule, list(SCHEDULES)))
+        self.schedule = schedule
+        #: Interleaved only: virtual chunks per stage (None → one
+        #: chunk per local block; root.common.engine.pp_chunks /
+        #: --pp-chunks overrides).
+        n_chunks = kwargs.get("n_chunks")
+        if n_chunks is None:
+            n_chunks = config_get(root.common.engine.pp_chunks, None)
+        self.n_chunks = n_chunks
         #: None → follow root.common.engine.remat; True/False forces.
         self.remat = kwargs.get("remat")
         #: Fused-QKV layout, frozen at construction like
@@ -519,8 +624,11 @@ class PipelinedTransformerStack(ForwardBase):
             # Mirrors apply_dp_pp_sharding's divisibility contract:
             # an indivisible stack stays replicated and runs the
             # sequential scan instead of crashing inside shard_map.
-            out = PL.gpipe(block_fn, params, x, mesh,
-                           self.stage_axis, self.n_microbatches)
+            out = PL.pipeline(
+                block_fn, params, x, mesh, self.stage_axis,
+                self.n_microbatches,
+                schedule=getattr(self, "schedule", "gpipe"),
+                n_chunks=getattr(self, "n_chunks", None))
         else:
             out = PL.sequential_stack(block_fn, params, x)
         write(self.output, out)

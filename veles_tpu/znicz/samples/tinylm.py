@@ -62,8 +62,10 @@ class TinyLMWorkflow(AcceleratedWorkflow):
                  minibatch_size=64, learning_rate=0.01,
                  gradient_moment=0.9, max_epochs=8, seq_axis=None,
                  sp_mode="ring",
-                 n_experts=0, expert_axis=None, pipelined=False,
-                 stage_axis=None, n_microbatches=4, fused_qkv=None,
+                 n_experts=0, expert_axis=None, top_k=None,
+                 router_z_weight=None, pipelined=False,
+                 stage_axis=None, n_microbatches=4, schedule=None,
+                 n_chunks=None, fused_qkv=None,
                  loader_cls=FirstTokenLoader, loader_config=None,
                  **kwargs):
         super(TinyLMWorkflow, self).__init__(workflow, **kwargs)
@@ -92,7 +94,8 @@ class TinyLMWorkflow(AcceleratedWorkflow):
             stack = PipelinedTransformerStack(
                 self, n_blocks=n_blocks, n_heads=n_heads,
                 causal=True, stage_axis=stage_axis,
-                n_microbatches=n_microbatches, fused_qkv=fused_qkv,
+                n_microbatches=n_microbatches, schedule=schedule,
+                n_chunks=n_chunks, fused_qkv=fused_qkv,
                 name="stack")
             stack.link_from(prev)
             stack.input = prev.output
@@ -104,8 +107,16 @@ class TinyLMWorkflow(AcceleratedWorkflow):
                 block = MoETransformerBlock(
                     self, n_heads=n_heads, causal=True,
                     seq_axis=seq_axis, sp_mode=sp_mode,
-                    n_experts=n_experts, fused_qkv=fused_qkv,
-                    expert_axis=expert_axis, name="block%d" % i)
+                    n_experts=n_experts, top_k=top_k,
+                    router_z_weight=router_z_weight,
+                    fused_qkv=fused_qkv, expert_axis=expert_axis,
+                    # Buckets the router-health accumulator rows by
+                    # sample class and gates padded ticks
+                    # (moe.aux_loss / moe.expert_load).
+                    minibatch_class_vec=(
+                        self.loader.minibatch_class_vec),
+                    minibatch_mask=self.loader.minibatch_mask,
+                    name="block%d" % i)
             else:
                 block = TransformerBlock(
                     self, n_heads=n_heads, causal=True,
@@ -166,6 +177,12 @@ def run(load, main):
          embed_dim=config_get(cfg.embed_dim, 32),
          n_heads=config_get(cfg.n_heads, 4),
          n_blocks=config_get(cfg.n_blocks, 1),
+         n_experts=config_get(cfg.n_experts, 0),
+         top_k=config_get(cfg.top_k, None),
+         router_z_weight=config_get(cfg.router_z_weight, None),
+         pipelined=config_get(cfg.pipelined, False),
+         schedule=config_get(cfg.schedule, None),
+         n_chunks=config_get(cfg.n_chunks, None),
          minibatch_size=config_get(cfg.minibatch_size, 64),
          learning_rate=config_get(cfg.learning_rate, 0.01),
          max_epochs=config_get(cfg.max_epochs, 8))
