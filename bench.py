@@ -75,9 +75,10 @@ A100_MLP_IMG_PER_SEC = 1.5e6
 #: references against this, so a flag mentioned in docs/*.md must
 #: exist here or in a real parser.
 BENCH_FLAGS = ("--mlp", "--lm", "--lm-toy", "--serve", "--streamed",
-               "--streamed-jpeg", "--attn-stages", "--serve-streams",
-               "--serve-seconds", "--trace-out", "--optimizer",
-               "--pp-schedule", "--moe-topk", "--moe-experts")
+               "--streamed-jpeg", "--attn-stages", "--attn-ladder",
+               "--serve-streams", "--serve-seconds", "--trace-out",
+               "--optimizer", "--pp-schedule", "--moe-topk",
+               "--moe-experts")
 
 # Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
 # or blocks gain <3% more.  The perf levers that got here: banded-
@@ -473,13 +474,19 @@ def build_mlp():
 
 #: The attention fast-path stages ``--attn-stages`` can toggle
 #: (docs/attention.md; each maps to one root.common.engine knob).
-ATTN_STAGES = ("fused", "bf16", "pallas")
+#: "ring" engages the ring-flash body inside sequence-parallel
+#: attention (multi-chip runs), "decode" the serving flash-decode
+#: kernel (meaningful under ``--serve``); both ride the JSON line
+#: either way so the record says what was measured.
+ATTN_STAGES = ("fused", "bf16", "pallas", "ring", "decode")
 
 
 def parse_attn_stages(argv):
-    """``--attn-stages=fused,bf16,pallas`` → the stage set for the LM
-    bench A/B protocol (BENCHNOTES r6): "none" (or absent) is the
-    r5 baseline, "all" turns every stage on."""
+    """``--attn-stages=fused,bf16,pallas,ring,decode`` → the stage
+    set for the LM bench A/B protocol (BENCHNOTES r6/r9): "none" (or
+    absent) is the r5 baseline — every knob explicitly OFF, which
+    matters now that auto-kernel defaults are on — and "all" turns
+    every stage on."""
     stages = None
     for arg in argv:
         if arg.startswith("--attn-stages="):
@@ -503,14 +510,21 @@ def parse_attn_stages(argv):
 
 def apply_attn_stages(stages):
     """Sets the engine knobs for the chosen stages (the same knobs
-    the --attn-* CLI flags set for a real run; the fused_qkv knob is
-    read at unit CONSTRUCTION, so this must run before build_lm)."""
+    the --attn-*/--sp-* CLI flags set for a real run; the fused_qkv
+    knob is read at unit CONSTRUCTION, so this must run before
+    build_lm).  Every knob is set BOTH ways: with auto-dispatch the
+    default since the r9 flip, the "none" baseline must force the
+    kernels off, not merely not-ask for them."""
     from veles_tpu.config import root
     root.common.engine.fused_qkv = "fused" in stages
     root.common.engine.attention_dtype = \
         "bf16" if "bf16" in stages else "f32"
     root.common.engine.attention_kernel = \
         "auto" if "pallas" in stages else "xla"
+    root.common.engine.sp_ring_kernel = \
+        "auto" if "ring" in stages else "xla"
+    root.common.engine.decode_kernel = \
+        "auto" if "decode" in stages else "off"
 
 
 def build_lm(vocab=LM_VOCAB, seq=LM_SEQ, embed=LM_EMBED,
@@ -550,6 +564,131 @@ def build_lm(vocab=LM_VOCAB, seq=LM_SEQ, embed=LM_EMBED,
         loader_config={"validate_labels": False})
     launcher.initialize()
     return launcher, wf
+
+
+#: --attn-ladder geometry: a compact LM (D = 64 so the CPU box can
+#: afford the full per-stage rebuild × measure matrix) plus the
+#: long-S dense-vs-ring-flash attention ladder.  Chip-scale numbers
+#: ride the --lm protocol when hardware is attached; this mode's job
+#: is the per-stage ORDERING and the scaling SHAPE.
+LADDER_VOCAB = 256
+LADDER_SEQ = 256
+LADDER_EMBED = 128
+LADDER_HEADS = 2
+LADDER_BLOCKS = 2
+LADDER_BATCH = 8
+LADDER_N_TRAIN = 64
+LADDER_N_VALID = 16
+#: Long-S ladder: weak-scaling shard size (per-device S under dp×sp
+#: stays fixed while devices grow with S — the regime the ring
+#: exists for), and the sequence points.
+LADDER_SHARD = 512
+LADDER_SEQS = (512, 1024, 2048, 4096)
+
+
+def attn_ladder_bench(argv):
+    """``--attn-ladder`` (BENCH_r09): two ladders in one JSON line.
+
+    1. The ``--attn-stages`` A/B at a compact LM geometry: for each
+       stage set the workflow is REBUILT under the stage knobs (the
+       fused layout freezes at construction) and the fused-step
+       training wall is measured — same protocol as
+       ``--lm --attn-stages=...``, sized so a CPU box can run the
+       whole matrix.  On a box without the TPU toolchain the pallas
+       stage degrades to its fallback by design (the dispatch
+       contract) — the row records it honestly.
+
+    2. The long-S ladder: dense single-device attention fwd+bwd wall
+       at each S, against the dp×sp ring-flash PER-DEVICE time at
+       the same S under weak scaling (shard size fixed at
+       ``LADDER_SHARD``, device count N = S/shard): per-device work
+       is N flash chunks of (shard × shard), so per-device time
+       ≈ N · t_chunk — LINEAR in S where the dense formulation grows
+       quadratically.  t_chunk is measured (interpret-mode kernel on
+       CPU — the math, not the lowering), each ring step's kernel
+       wall at the fixed shard geometry; the dense row is measured
+       outright.
+    """
+    import numpy
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.ops import attention as A
+    from veles_tpu.ops import pallas_attention as PA
+
+    stage_rows = {}
+    for stages in ((), ("fused",), ("bf16",), ("fused", "bf16"),
+                   ("fused", "bf16", "pallas")):
+        apply_attn_stages(stages)
+        _, wf = build_lm(
+            vocab=LADDER_VOCAB, seq=LADDER_SEQ, embed=LADDER_EMBED,
+            heads=LADDER_HEADS, blocks=LADDER_BLOCKS,
+            batch=LADDER_BATCH, n_train=LADDER_N_TRAIN,
+            n_valid=LADDER_N_VALID, remat=False)
+        ips = measure(wf, epochs=2)
+        stage_rows[",".join(stages) or "none"] = {
+            "tokens_per_sec": round(ips * LADDER_SEQ, 1),
+        }
+    apply_attn_stages(())
+
+    def timed(fn, *args, repeats=3):
+        def sync(tree):
+            leaves = jax.tree_util.tree_leaves(tree)
+            numpy.array(jax.device_get(leaves[0].ravel()[0]))
+
+        sync(fn(*args))  # compile
+        t0 = time.time()
+        for _ in range(repeats):
+            out = fn(*args)
+        sync(out)
+        return (time.time() - t0) / repeats * 1e3
+
+    B, H, D = 1, 2, 64
+    shard = LADDER_SHARD
+
+    def make(S, seed):
+        rng = numpy.random.RandomState(seed)
+        return [jnp.asarray(rng.normal(0, 1, (B, S, H, D))
+                            .astype(numpy.float32))
+                for _ in range(3)]
+
+    # One ring step's kernel wall at the fixed shard geometry
+    # (fwd+bwd through the chunk's custom VJP — what every device
+    # runs N times per step under dp×sp).
+    qc, kc, vc = make(shard, 7)
+    t_chunk = timed(jax.jit(jax.grad(lambda q, k, v: (
+        PA.flash_chunk(q, k, v, causal=True,
+                       operand_dtype=jnp.float32,
+                       interpret=True)[0] ** 2).sum(),
+        argnums=(0, 1, 2))), qc, kc, vc)
+
+    ladder = []
+    for S in LADDER_SEQS:
+        q, k, v = make(S, S)
+        dense_ms = timed(jax.jit(jax.grad(lambda q, k, v: (
+            A.attention(q, k, v, causal=True, kernel="xla")
+            ** 2).sum(), argnums=(0, 1, 2))), q, k, v)
+        n_dev = max(1, S // shard)
+        ladder.append({
+            "seq": S,
+            "dense_1dev_fwd_bwd_ms": round(dense_ms, 3),
+            "ring_flash_devices": n_dev,
+            "ring_flash_per_device_ms": round(n_dev * t_chunk, 3),
+        })
+    print(json.dumps({
+        "metric": "attn_ladder",
+        "unit": "ms_and_tokens_per_sec",
+        # vs_baseline: best stage set over the r5-style baseline.
+        "value": round(max(r["tokens_per_sec"]
+                           for r in stage_rows.values()), 1),
+        "vs_baseline": round(
+            max(r["tokens_per_sec"] for r in stage_rows.values()) /
+            stage_rows["none"]["tokens_per_sec"], 4),
+        "vs_baseline_meaning": "best_stage_set_over_none",
+        "stages": stage_rows,
+        "ring_flash_chunk_ms": round(t_chunk, 3),
+        "ring_flash_shard": shard,
+        "long_s_ladder": ladder,
+    }))
 
 
 def build_alexnet_streamed():
@@ -1029,6 +1168,9 @@ def main():
         return
     if "--serve" in sys.argv:
         serve_bench(sys.argv)
+        return
+    if "--attn-ladder" in sys.argv:
+        attn_ladder_bench(sys.argv)
         return
     if "--streamed-jpeg" in sys.argv:
         base = os.environ.get(

@@ -34,12 +34,17 @@ from veles_tpu.launcher import Launcher
 def engine_knobs():
     """Restores the attention fast-path knobs to their defaults (the
     tests flip them; a leak would silently change every later test's
-    math)."""
+    math).  Kernel-mode defaults are "auto" since the r9 flip —
+    restoring "xla" here would leak the OLD default forward."""
     from veles_tpu.config import root
+    from veles_tpu.ops.attention import (DEFAULT_KERNEL_MODE,
+                                         DEFAULT_RING_KERNEL_MODE)
     yield root.common.engine
     root.common.engine.fused_qkv = False
     root.common.engine.attention_dtype = "f32"
-    root.common.engine.attention_kernel = "xla"
+    root.common.engine.attention_kernel = DEFAULT_KERNEL_MODE
+    root.common.engine.sp_ring_kernel = DEFAULT_RING_KERNEL_MODE
+    root.common.engine.decode_kernel = "off"
 
 
 def _rand(shape, seed=0):

@@ -581,9 +581,20 @@ def test_job_serialization_does_not_block_updates():
 
 def test_nojob_backoff_grows_and_resets():
     """The fixed no-job sleep is gone: backoff grows exponentially
-    with jitter on the RetryPolicy and resets on the next real job."""
+    with jitter on the RetryPolicy and resets on the next real job.
+    The policy's jitter rng is SEEDED here (production brings its
+    own unseeded rng — idle-poll draws are wall-clock-paced by
+    nature): the envelope assertions below compare sampled delays
+    against each other, and an unlucky draw pair could sit inside
+    the jitter band — the pre-ISSUE-13 flake this pins away."""
+    import random as _random
+    from veles_tpu.resilience import RetryPolicy
     slave = _ProtoWorkflow()
-    client = Client("127.0.0.1:1", slave, poll_delay=0.01)
+    client = Client(
+        "127.0.0.1:1", slave, poll_delay=0.01,
+        nojob_policy=RetryPolicy(
+            max_attempts=1 << 30, base_delay=0.01, factor=1.5,
+            max_delay=2.0, rng=_random.Random(1234)))
     delays = []
     client._sleep_interruptible = delays.append
     for _ in range(8):

@@ -568,8 +568,18 @@ def test_completed_run_retires_workers_cleanly():
     server.wait(timeout=20)
     thread.join(timeout=10)
     assert master.done == {1: 1, 2: 1, 3: 1}
-    assert resilience.stats.get("server.drop") == 0
+    # The goodbye lands when the SERVER's connection handler unwinds
+    # past its finally — strictly after the client thread exits, so
+    # a raced read here was the pre-ISSUE-13 flake.  Poll like the
+    # sibling goodbye test; drop is asserted AFTER the handler has
+    # provably retired the worker, when a mis-classified retirement
+    # would actually be visible.
+    deadline = time.time() + 5
+    while resilience.stats.get("server.goodbye") < 1 and \
+            time.time() < deadline:
+        time.sleep(0.01)
     assert resilience.stats.get("server.goodbye") >= 1
+    assert resilience.stats.get("server.drop") == 0
 
 
 def test_blacklist_parole_readmits_on_probation():

@@ -110,6 +110,42 @@ def test_vl101_shard_map_closures_are_entries(tmp_path):
     assert _rules(findings) == {"VL102"}, findings
 
 
+def test_vl102_partial_and_dict_dispatch_entries(tmp_path):
+    """The ring-step registration shape (ISSUE 13): the traced body
+    reaches shard_map through ``functools.partial`` over a
+    DICT-dispatched alias (ops/attention.sequence_parallel_attention
+    hands ``partial(modes[mode], ...)`` to shard_map) — entry
+    discovery must unwrap both, so hazards inside the ring body and
+    its per-step helper are caught."""
+    findings = _lint(tmp_path, """
+        import functools
+        import time
+        from jax.experimental.shard_map import shard_map
+
+        def _step_helper(x):
+            return x * time.time()
+
+        def ring_body(q, k, axis_name=None):
+            return _step_helper(q) + k
+
+        def ulysses_body(q, k, axis_name=None):
+            return q + k
+
+        def dispatch(q, k, mesh, mode):
+            modes = {"ring": ring_body, "ulysses": ulysses_body}
+            inner = modes[mode]
+            fn = shard_map(functools.partial(inner, axis_name="s"),
+                           mesh=mesh)
+            return fn(q, k)
+        """)
+    hits = [f for f in findings if f.rule == "VL102"]
+    assert hits and _rules(findings) == {"VL102"}, findings
+    assert any("time" in f.message for f in hits)
+    # ...reached THROUGH the dispatch table into the nested helper
+    # (the message names the entry the walk came from).
+    assert all("ring_body" in f.message for f in hits), hits
+
+
 def test_vl101_host_code_not_flagged(tmp_path):
     """The builder around a jitted closure is host code — its numpy
     calls are legitimate and must NOT be flagged."""

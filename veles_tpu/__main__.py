@@ -325,6 +325,11 @@ class Main(Logger, CommandLineBase):
             root.common.engine.attention_dtype = args.attn_dtype
         if args.attn_kernel is not None:
             root.common.engine.attention_kernel = args.attn_kernel
+        if args.sp_ring_kernel is not None:
+            root.common.engine.sp_ring_kernel = args.sp_ring_kernel
+        if args.attn_decode_kernel is not None:
+            root.common.engine.decode_kernel = \
+                args.attn_decode_kernel
         # Pipeline-schedule / MoE-routing knobs (ops/pipeline.py and
         # ops/moe.py init_parser; docs/pipeline.md, docs/moe.md) —
         # read back at unit construction.

@@ -239,6 +239,65 @@ class TraceWalker(object):
             return None
         return None
 
+    def _is_partial_call(self, call, idx):
+        """``functools.partial(fn, ...)`` in either import form —
+        the wrapper ops/attention's sequence-parallel dispatch hands
+        to ``shard_map`` (the ring/ulysses bodies register through
+        it, ISSUE 13)."""
+        func = call.func
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            return idx.import_mods.get(func.value.id) == \
+                "functools" and func.attr == "partial"
+        if isinstance(func, ast.Name):
+            return idx.from_imports.get(func.id) == \
+                ("functools", "partial")
+        return False
+
+    def _tracer_arg_targets(self, arg, info, idx, aliases, depth=0):
+        """Every FuncInfo a tracer-call argument may statically
+        denote.  Beyond plain names/attributes this unwraps
+        ``functools.partial(fn, ...)`` (yielding fn's targets) and
+        follows single-assignment aliases through DICT-LITERAL
+        subscripts (``modes = {"ring": ring_attention, ...};
+        inner = modes[mode]`` — the sequence-parallel dispatch
+        table: every value is a potential entry, so ALL are
+        yielded)."""
+        if depth > 6:
+            # partial(name) → alias → subscript → alias → dict is a
+            # 5-hop chain; 6 bounds pathological self-references.
+            return []
+        if isinstance(arg, (ast.Name, ast.Attribute)):
+            targets = []
+            target = self.resolve_call(arg, info, idx, aliases)
+            if target is not None:
+                targets.append(target)
+            if isinstance(arg, ast.Name) and arg.id in aliases:
+                value = aliases[arg.id]
+                if not isinstance(value, (ast.Name, ast.Attribute)):
+                    targets.extend(self._tracer_arg_targets(
+                        value, info, idx, aliases, depth + 1))
+            return targets
+        if isinstance(arg, ast.Call) and \
+                self._is_partial_call(arg, idx):
+            if arg.args:
+                return self._tracer_arg_targets(
+                    arg.args[0], info, idx, aliases, depth + 1)
+            return []
+        if isinstance(arg, ast.Subscript):
+            return self._tracer_arg_targets(
+                arg.value, info, idx, aliases, depth + 1)
+        if isinstance(arg, ast.Dict):
+            out = []
+            for value in arg.values:
+                if isinstance(value, (ast.Name, ast.Attribute)):
+                    target = self.resolve_call(value, info, idx,
+                                               aliases)
+                    if target is not None:
+                        out.append(target)
+            return out
+        return []
+
     def _resolve_method(self, cls, name, seen=None):
         if cls is None:
             return None
@@ -309,11 +368,8 @@ class TraceWalker(object):
                             not self._is_tracer_call(node, idx):
                         continue
                     for arg in node.args:
-                        if isinstance(arg, (ast.Name, ast.Attribute)):
-                            target = self.resolve_call(
-                                arg, info, idx, aliases)
-                            if target is not None:
-                                out.append(target)
+                        out.extend(self._tracer_arg_targets(
+                            arg, info, idx, aliases))
             # Module-level tracer calls (decorator-style jit at
             # import time: ``fn = jax.jit(fn)`` or ``@jax.jit``).
             for info in idx.all_funcs:
@@ -343,19 +399,18 @@ class TraceWalker(object):
             idx = self.modules[info.sf.modname]
             aliases = self._local_aliases(info)
             for node in _own_statements(info.node):
-                targets = []
+                callees = []
                 if isinstance(node, ast.Call):
-                    targets.append(node.func)
-                    if self._is_tracer_call(node, idx):
-                        targets.extend(
-                            a for a in node.args
-                            if isinstance(a, (ast.Name,
-                                              ast.Attribute)))
-                for expr in targets:
-                    callee = self.resolve_call(expr, info, idx,
+                    callee = self.resolve_call(node.func, info, idx,
                                                aliases)
-                    if callee is not None and \
-                            id(callee.node) not in reached:
+                    if callee is not None:
+                        callees.append(callee)
+                    if self._is_tracer_call(node, idx):
+                        for a in node.args:
+                            callees.extend(self._tracer_arg_targets(
+                                a, info, idx, aliases))
+                for callee in callees:
+                    if id(callee.node) not in reached:
                         reached[id(callee.node)] = callee
                         callee.reached_from = info.reached_from
                         queue.append(callee)

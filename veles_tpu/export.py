@@ -1045,6 +1045,57 @@ class ExportedModel(object):
         return functools.partial(attention, causal=causal,
                                  precision="f32", kernel="xla")
 
+    @staticmethod
+    def _decode_kernel_mode():
+        """The ONE explicit gate through which the attention fast
+        path may reach serving: ``root.common.engine.decode_kernel``
+        ("off" default — the f32/xla pin stands until the decode
+        kernel's token-identity gate passes on the target platform).
+        "pallas"/"auto" engage the flash-decode kernel where the
+        compiled probe and geometry allow; "interpret" forces the
+        interpret-mode kernel (the CPU token-identity tests — never
+        a production setting)."""
+        from .config import root, get as config_get
+        mode = str(config_get(root.common.engine.decode_kernel,
+                              "off"))
+        if mode not in ("off", "pallas", "auto", "interpret"):
+            raise Bug("unknown decode kernel mode %r — valid: off, "
+                      "pallas, auto, interpret" % (mode,))
+        return mode
+
+    @classmethod
+    def _decode_attend(cls):
+        """None (the dense inline math) unless the decode-kernel
+        gate is on; otherwise an ``attend(q, kc, vc, key_mask)``
+        hook — the serving twin of the training path's ``attend=``
+        override — that returns the flash-decode result, or None
+        when the traced shapes sit outside the decode contract
+        (prefill chunks, odd geometry) so the caller's dense
+        formulation proceeds unchanged.  Resolved at program BUILD
+        time; the mode string rides every decode compile-cache key,
+        so flipping the knob can never serve a stale executable."""
+        mode = cls._decode_kernel_mode()
+        if mode == "off":
+            return None
+        import jax.numpy as jnp
+        from .ops import pallas_attention as PA
+        interpret = mode == "interpret"
+
+        def attend(q, kc, vc, key_mask):
+            if not PA.supports_decode(q.shape, kc.shape,
+                                      interpret=interpret):
+                return None
+            if not interpret and not PA.pallas_decode_available():
+                return None
+            # f32 operands: the serving surfaces promise f32 math —
+            # the kernel changes the REDUCTION ORDER only, which the
+            # token-identity gate covers.
+            return PA.pallas_decode_attention(
+                q, kc, vc, key_mask, operand_dtype=jnp.float32,
+                interpret=interpret)
+
+        return attend
+
     def _jax_chain(self, x, weights=None):
         """The traced forward chain.  ``weights`` is the pytree the
         jit passes as an ARGUMENT (hot-swappable); None falls back to
@@ -1188,7 +1239,7 @@ class ExportedModel(object):
         return entries[0], entries[1:-1], entries[-1]
 
     def _cached_block(self, p, x, ck, cv, start, n_heads,
-                      key_mask=None):
+                      key_mask=None, attend=None):
         """One pre-LN block over a chunk of positions
         [start, start+s) with a (B, L, H, D) KV cache: the chunk's
         k/v are written into the cache, queries attend the WHOLE
@@ -1204,7 +1255,14 @@ class ExportedModel(object):
         requests of different true lengths cannot see each other's
         padding (attention is permutation-invariant over key slots:
         masking pads and keeping logical positions in the embeddings
-        reproduces the unpadded computation exactly)."""
+        reproduces the unpadded computation exactly).
+
+        ``attend`` (the :meth:`_decode_attend` hook): when set AND it
+        accepts the traced shapes, attention runs through the
+        flash-decode kernel instead of the dense einsums — the SAME
+        mask, so masked slots stay exact zeros; it returns None for
+        out-of-contract shapes (prefills) and the dense path below
+        proceeds untouched."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -1233,17 +1291,21 @@ class ExportedModel(object):
         cv = lax.dynamic_update_slice(cv, vn, (0, start, 0, 0))
         if key_mask is None:
             qpos = start + jnp.arange(S_)
-            mask = (qpos[:, None] >=
-                    jnp.arange(L)[None, :])[None, :, None, :]
+            kmask = jnp.broadcast_to(
+                (qpos[:, None] >= jnp.arange(L)[None, :])[None],
+                (B, S_, L))
         else:
-            mask = key_mask[:, :, None, :]
-        scores = jnp.einsum(
-            "bqhd,bkhd->bqhk", q, ck,
-            preferred_element_type=jnp.float32) / (D ** 0.5)
-        scores = jnp.where(mask, scores, -1e30)
-        w = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bqhk,bkhd->bqhd", w, cv).reshape(B, S_, E)
-        x = x + attn @ p["wo"] + p["bo"]
+            kmask = key_mask
+        attn = attend(q, ck, cv, kmask) if attend is not None \
+            else None
+        if attn is None:
+            scores = jnp.einsum(
+                "bqhd,bkhd->bqhk", q, ck,
+                preferred_element_type=jnp.float32) / (D ** 0.5)
+            scores = jnp.where(kmask[:, :, None, :], scores, -1e30)
+            w = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("bqhk,bkhd->bqhd", w, cv)
+        x = x + attn.reshape(B, S_, E) @ p["wo"] + p["bo"]
         h = ln(x, p["ln2_g"], p["ln2_b"])
         x = x + jnp.maximum(h @ p["w1"] + p["b1"], 0.0) @ p["w2"] \
             + p["b2"]
@@ -1291,6 +1353,8 @@ class ExportedModel(object):
                 axis=-1).astype(jnp.int32)
             return jnp.where(temperature > 0.0, sampled, greedy)
 
+        att = self._decode_attend()
+
         def run(params, prompt, key, temperature):
             B = prompt.shape[0]
             block_params = params["blocks"]
@@ -1299,7 +1363,8 @@ class ExportedModel(object):
             for p, H in zip(block_params, n_heads):
                 ck = jnp.zeros((B, L, H, E // H), jnp.float32)
                 cv = jnp.zeros((B, L, H, E // H), jnp.float32)
-                x, ck, cv = self._cached_block(p, x, ck, cv, 0, H)
+                x, ck, cv = self._cached_block(p, x, ck, cv, 0, H,
+                                               attend=att)
                 caches.append((ck, cv))
             first_logits = logits_of(params, x[:, -1])
             tok0 = sample(first_logits, jax.random.fold_in(key, 0),
@@ -1312,7 +1377,8 @@ class ExportedModel(object):
                 new_caches = []
                 for (ck, cv), p, H in zip(caches, block_params,
                                           n_heads):
-                    x, ck, cv = self._cached_block(p, x, ck, cv, t, H)
+                    x, ck, cv = self._cached_block(p, x, ck, cv, t, H,
+                                                   attend=att)
                     new_caches.append((ck, cv))
                 logits = logits_of(params, x[:, 0])
                 tok = sample(logits, jax.random.fold_in(key, j + 1),
@@ -1397,7 +1463,7 @@ class ExportedModel(object):
         # through the serving endpoint, so it must not grow without
         # bound.
         fn = self.compile_cache.get_or_build(
-            ("gen", S0, max_new),
+            ("gen", S0, max_new, self._decode_kernel_mode()),
             lambda: self._build_generate(S0, max_new))
         tokens, logits = fn(self._lm_params(), prompt,
                             jax.random.PRNGKey(seed),
@@ -1447,6 +1513,7 @@ class ExportedModel(object):
                                 params["head_b"])
 
         sample_rows = _sample_rows
+        att = self._decode_attend()
 
         def run(params, prompts, lengths, seeds, temps):
             B = prompts.shape[0]
@@ -1460,7 +1527,8 @@ class ExportedModel(object):
             for p, H in zip(block_params, n_heads):
                 ck = jnp.zeros((B, L, H, E // H), jnp.float32)
                 cv = jnp.zeros((B, L, H, E // H), jnp.float32)
-                x, ck, cv = self._cached_block(p, x, ck, cv, 0, H)
+                x, ck, cv = self._cached_block(p, x, ck, cv, 0, H,
+                                               attend=att)
                 caches.append((ck, cv))
             idx = jnp.clip(lengths - 1, 0, S0b - 1)
             first_logits = logits_of(params, x[jnp.arange(B), idx])
@@ -1488,7 +1556,8 @@ class ExportedModel(object):
                 for (ck, cv), p, H in zip(caches, block_params,
                                           n_heads):
                     xj, ck, cv = self._cached_block(
-                        p, xj, ck, cv, slot, H, key_mask=kmask)
+                        p, xj, ck, cv, slot, H, key_mask=kmask,
+                        attend=att)
                     new_caches.append((ck, cv))
                 logits = logits_of(params, xj[:, 0])
                 tok = sample_rows(
@@ -1551,7 +1620,7 @@ class ExportedModel(object):
                 "prompt of %d tokens exceeds the model's positional "
                 "table (%d)" % (max(S0b, int(lengths.max())), limit))
         fn = self.compile_cache.get_or_build(
-            ("genb", B, S0b, max_new),
+            ("genb", B, S0b, max_new, self._decode_kernel_mode()),
             lambda: self._build_generate_bucketed(S0b, max_new))
         return numpy.asarray(fn(self._lm_params(), prompts, lengths,
                                 seeds, temps))
@@ -1609,7 +1678,7 @@ class ExportedModel(object):
         return fn(ks, vs, *src_dst)
 
     def _paged_block(self, p, x, pk, pv, tables, wblock, wslot,
-                     key_mask, n_heads):
+                     key_mask, n_heads, attend=None):
         """One pre-LN block against the POOLED cache: the chunk's
         k/v scatter to ``(wblock, wslot)`` (physical block, in-block
         slot — per row AND per chunk position, so rows at different
@@ -1618,7 +1687,9 @@ class ExportedModel(object):
         queries attend it under ``key_mask``.  Same arithmetic as
         :meth:`_cached_block` — masked slots are exact zeros after
         softmax and real keys keep their relative order, so paged
-        greedy decode is bit-identical to the dense cached path."""
+        greedy decode is bit-identical to the dense cached path.
+        ``attend`` is the flag-gated flash-decode hook, exactly as
+        in :meth:`_cached_block` (same mask, same zeros)."""
         import jax
         import jax.numpy as jnp
 
@@ -1643,13 +1714,17 @@ class ExportedModel(object):
         pv = pv.at[wblock, wslot].set(vn)
         kc = pk[tables].reshape(B, -1, H, D)
         vc = pv[tables].reshape(B, -1, H, D)
-        scores = jnp.einsum(
-            "bqhd,bkhd->bqhk", q, kc,
-            preferred_element_type=jnp.float32) / (D ** 0.5)
-        scores = jnp.where(key_mask[:, :, None, :], scores, -1e30)
-        w = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum("bqhk,bkhd->bqhd", w, vc).reshape(B, S_, E)
-        x = x + attn @ p["wo"] + p["bo"]
+        attn = attend(q, kc, vc, key_mask) if attend is not None \
+            else None
+        if attn is None:
+            scores = jnp.einsum(
+                "bqhd,bkhd->bqhk", q, kc,
+                preferred_element_type=jnp.float32) / (D ** 0.5)
+            scores = jnp.where(key_mask[:, :, None, :], scores,
+                               -1e30)
+            w = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum("bqhk,bkhd->bqhd", w, vc)
+        x = x + attn.reshape(B, S_, E) @ p["wo"] + p["bo"]
         h = ln(x, p["ln2_g"], p["ln2_b"])
         x = x + jnp.maximum(h @ p["w1"] + p["b1"], 0.0) @ p["w2"] \
             + p["b2"]
@@ -1688,6 +1763,7 @@ class ExportedModel(object):
                                 params["head_b"])
 
         sample_rows = _sample_rows
+        att = self._decode_attend()
 
         def run(params, pks, pvs, tables, tokens, prior, chunk_len,
                 temps, seeds):
@@ -1711,7 +1787,8 @@ class ExportedModel(object):
             for pk, pv, p, H in zip(pks, pvs, params["blocks"],
                                     n_heads):
                 x, pk, pv = self._paged_block(
-                    p, x, pk, pv, tables, wblock, wslot, key_mask, H)
+                    p, x, pk, pv, tables, wblock, wslot, key_mask, H,
+                    attend=att)
                 new_pks.append(pk)
                 new_pvs.append(pv)
             idx = jnp.clip(chunk_len - 1, 0, Sc - 1)
@@ -1744,6 +1821,7 @@ class ExportedModel(object):
                                 params["head_b"])
 
         sample_rows = _sample_rows
+        att = self._decode_attend()
 
         def run(params, pks, pvs, tables, pos, tok, gen_idx, temps,
                 seeds):
@@ -1761,7 +1839,8 @@ class ExportedModel(object):
             for pk, pv, p, H in zip(pks, pvs, params["blocks"],
                                     n_heads):
                 x, pk, pv = self._paged_block(
-                    p, x, pk, pv, tables, wblock, wslot, key_mask, H)
+                    p, x, pk, pv, tables, wblock, wslot, key_mask, H,
+                    attend=att)
                 new_pks.append(pk)
                 new_pvs.append(pv)
             logits = logits_of(params, x[:, 0])
@@ -1789,7 +1868,8 @@ class ExportedModel(object):
         B, T = tables.shape
         Sc = tokens.shape[1]
         fn = self.compile_cache.get_or_build(
-            ("pext", B, Sc, T, pool.n_blocks, pool.block_size),
+            ("pext", B, Sc, T, pool.n_blocks, pool.block_size,
+             self._decode_kernel_mode()),
             lambda: self._build_paged_extend(Sc, T, pool.block_size))
         ks, vs = pool.storage
         # EXPLICIT upload of the per-call host arrays: the serving
@@ -1814,7 +1894,8 @@ class ExportedModel(object):
         tables = numpy.ascontiguousarray(tables, dtype=numpy.int32)
         B, T = tables.shape
         fn = self.compile_cache.get_or_build(
-            ("pstep", B, T, pool.n_blocks, pool.block_size),
+            ("pstep", B, T, pool.n_blocks, pool.block_size,
+             self._decode_kernel_mode()),
             lambda: self._build_paged_step(T, pool.block_size))
         ks, vs = pool.storage
         # Explicit upload — see paged_extend (strict_step contract).

@@ -31,14 +31,24 @@ vs ~1.5 ms of FLOP time at B=8/S=1024/H=16/D=128):
     per block instead of three (znicz/attention.py);
   * ``root.common.engine.attention_dtype`` — "f32" (default) or
     "bf16" score/accumulator intermediates (this module);
-  * ``root.common.engine.attention_kernel`` — "xla" (default),
-    "pallas", or "auto": route :func:`attention` /
+  * ``root.common.engine.attention_kernel`` — "auto" (default since
+    the ISSUE 13 flip), "pallas", or "xla": route :func:`attention` /
     :func:`blockwise_attention` through the geometry-tuned Pallas
     flash kernel (ops/pallas_attention.py) when the platform
-    supports it.
+    supports it;
+  * ``root.common.engine.sp_ring_kernel`` — "auto" (default),
+    "pallas", or "xla": run each ring-attention step through the
+    flash kernel on the ppermuted k/v shard with global causal
+    offsets, merging partials by lse (ring-flash — the multi-chip
+    composition of the kernel; docs/attention.md "Long context");
+  * ``root.common.engine.decode_kernel`` — "off" (default: serving
+    keeps its f32/xla pin), "pallas"/"auto"/"interpret": the
+    flash-decode kernel behind export.py's cached/paged decode
+    chain (token-identity gated).
 
-Each knob has a ``--attn-*`` CLI flag (init_parser below) and an A/B
-hook in ``bench.py --lm`` so the win is attributed per stage.
+Each knob has a ``--attn-*``/``--sp-*`` CLI flag (init_parser below)
+and an A/B hook in ``bench.py --lm`` so the win is attributed per
+stage.
 """
 
 import functools
@@ -57,6 +67,19 @@ SP_MODES = ("ring", "ulysses")
 
 #: Valid attention-kernel dispatch modes.
 KERNEL_MODES = ("xla", "pallas", "auto")
+
+#: Default attention-kernel mode — "auto" since ISSUE 13 (the r6
+#: roofline puts the flash kernel AT the bandwidth corner vs the XLA
+#: formulation's ~7.4× traffic, and dispatch degrades silently
+#: off-TPU/off-geometry, so auto is free where it cannot win).
+#: Serving surfaces pin kernel="xla" explicitly and never read this.
+DEFAULT_KERNEL_MODE = "auto"
+
+#: Default ring-kernel mode for sequence-parallel attention — the
+#: ring-flash body (per-shard Pallas flash + lse merge) engages
+#: wherever the platform/geometry supports it, with the lax scan as
+#: the silent fallback.
+DEFAULT_RING_KERNEL_MODE = "auto"
 
 
 def init_parser(parser):
@@ -79,8 +102,25 @@ def init_parser(parser):
         help="attention fast path: 'pallas' routes attention through "
              "the geometry-tuned flash kernel "
              "(ops/pallas_attention.py) where the platform supports "
-             "it, 'auto' probes, 'xla' (default) keeps the fused XLA "
+             "it, 'auto' (default since the r9 flip) probes and "
+             "degrades silently, 'xla' keeps the fused XLA "
              "formulation")
+    parser.add_argument(
+        "--sp-ring-kernel", default=None, choices=KERNEL_MODES,
+        help="sequence-parallel long-context path: 'pallas'/'auto' "
+             "(default) run each ring step through the flash kernel "
+             "on the ppermuted k/v shard with global causal offsets, "
+             "merging partials by lse (ring-flash, "
+             "docs/attention.md); 'xla' keeps the lax streaming scan")
+    parser.add_argument(
+        "--attn-decode-kernel", default=None,
+        choices=("off", "pallas", "auto", "interpret"),
+        help="serving decode kernel: 'pallas'/'auto' route the "
+             "cached/paged one-token decode steps through the "
+             "flash-decode kernel (k/v-split grid + lse merge) where "
+             "supported; 'interpret' forces the interpret-mode "
+             "kernel (tests/CI); 'off' (default — serving keeps its "
+             "f32/xla pin until the token-identity gate flips it)")
 
 
 def attention_compute_dtype(precision=None):
@@ -103,10 +143,20 @@ def attention_compute_dtype(precision=None):
 
 
 def _kernel_mode():
-    mode = str(config_get(root.common.engine.attention_kernel, "xla"))
+    mode = str(config_get(root.common.engine.attention_kernel,
+                          DEFAULT_KERNEL_MODE))
     if mode not in KERNEL_MODES:
         raise ValueError("unknown attention kernel mode %r — valid: "
                          "%s" % (mode, list(KERNEL_MODES)))
+    return mode
+
+
+def _ring_kernel_mode():
+    mode = str(config_get(root.common.engine.sp_ring_kernel,
+                          DEFAULT_RING_KERNEL_MODE))
+    if mode not in KERNEL_MODES:
+        raise ValueError("unknown ring kernel mode %r — valid: %s" %
+                         (mode, list(KERNEL_MODES)))
     return mode
 
 
@@ -117,10 +167,16 @@ def _try_pallas(q, k, v, causal, kv_len=None, mode=None,
     supports it; returns None (→ caller falls through to the jnp
     formulation) otherwise.  "pallas" and "auto" behave identically —
     both degrade silently, so a CPU test run with the flag on still
-    exercises the reference path.  An explicit ``precision`` wins
-    inside the kernel too: it becomes the matmul operand dtype, so
-    ``precision="f32"`` is honored (exactly) rather than silently
-    downgraded to the kernel's bf16 default."""
+    exercises the reference path.  The matmul operand dtype follows
+    the ``attention_dtype`` knob (or the explicit ``precision``)
+    exactly like every other formulation — f32 by default, bf16
+    under the bf16 stage.  With the kernel now engaging by DEFAULT
+    ("auto" since the r9 flip) this matters: the pre-flip behavior
+    of defaulting the operands to the kernel's bf16 MXU contract
+    would silently downgrade a default-config (or explicit
+    --attn-dtype f32) run the moment the platform supports the
+    kernel — the dtype stage must stay an explicit opt-in, as the
+    flip table documents."""
     if (mode or _kernel_mode()) == "xla":
         return None
     from . import pallas_attention as PA
@@ -128,10 +184,9 @@ def _try_pallas(q, k, v, causal, kv_len=None, mode=None,
         return None
     if not PA.pallas_attention_available():
         return None
-    od = attention_compute_dtype(precision) \
-        if precision is not None else None
-    return PA.pallas_attention(q, k, v, causal=causal, kv_len=kv_len,
-                               operand_dtype=od)
+    return PA.pallas_attention(
+        q, k, v, causal=causal, kv_len=kv_len,
+        operand_dtype=attention_compute_dtype(precision))
 
 
 def _block_update(acc, m, l, q, k, v, *, scale, mask=None):
@@ -269,18 +324,89 @@ def blockwise_attention(q, k, v, block_size=128, causal=False,
     return _finish(acc, l, q.dtype)
 
 
-def ring_attention(q, k, v, axis_name, causal=False):
+def _try_ring_flash(q, k, mode, interpret):
+    """Whether this ring call should run the Pallas flash body:
+    the knob (or explicit ``kernel`` override) asks for it AND the
+    per-shard geometry fits AND the kernel actually runs here
+    (compiled probe on TPU; ``interpret=True`` — the test/dryrun
+    path — runs the interpret kernel anywhere).  False falls through
+    to the lax streaming scan, the same silent-degrade contract as
+    ``_try_pallas``."""
+    if mode == "xla":
+        return False
+    from . import pallas_attention as PA
+    if not PA.supports_ring(q.shape, k.shape, interpret=interpret):
+        return False
+    return interpret or PA.pallas_attention_available()
+
+
+def _ring_flash(q, k, v, axis_name, causal, od, interpret):
+    """The ring-flash body: every ring step invokes the Pallas flash
+    kernel on the currently-held (ppermuted) k/v shard with GLOBAL
+    causal offsets — the source rank's shard start, a traced scalar
+    the kernel masks by — and the per-step partials merge by lse
+    (``pallas_attention.merge_partials``).  The steps unroll in
+    Python (the axis size is static inside shard_map), and the
+    backward stays autodiff-derived: each chunk's custom VJP
+    recomputes its probabilities from the saved lse, the merge and
+    the reversed ppermutes differentiate as plain jax — recompute-
+    from-lse per ring step, exactly the single-chip kernel's
+    contract stretched across the ring."""
+    from . import pallas_attention as PA
+    n = lax.psum(1, axis_name)
+    rank = lax.axis_index(axis_name)
+    B, Sq, H, D = q.shape
+    q_offset = (rank * Sq).astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    carry = None
+    kr, vr = k, v
+    for step in range(n):
+        # The k/v shard currently held arrived from `rank - step`.
+        # flash_resume holds the carried partial f32 across every
+        # merge (one rounding at the final cast, like the lax ring's
+        # single f32 accumulator).
+        src = (rank - step) % n
+        carry = PA.flash_resume(
+            carry, q, kr, vr, causal=causal, q_offset=q_offset,
+            k_offset=(src * Sq).astype(jnp.float32),
+            operand_dtype=od, interpret=interpret)
+        if step != n - 1:
+            kr = lax.ppermute(kr, axis_name, perm)
+            vr = lax.ppermute(vr, axis_name, perm)
+    out, _lse = carry
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, kernel=None,
+                   precision=None, interpret=None):
     """Sequence-parallel attention INSIDE ``shard_map``: each device
     holds its (B, S/N, H, D) shard; N ring steps ppermute the k/v
     shard to the next device while folding the arriving block into
     the local queries' accumulator.  Communication rides ICI and
     overlaps the einsums; peak memory per device is O(S/N) — the
     long-context enabler.
+
+    ``kernel``: None → the ``sp_ring_kernel`` knob ("auto" default);
+    "pallas"/"auto" run each step through the Pallas flash kernel on
+    the held shard (the ring-flash body, :func:`_ring_flash`) where
+    the platform/geometry supports it, "xla" forces the lax scan.
+    ``precision`` follows the ``attention_dtype`` knob as everywhere
+    (in the flash body it becomes the matmul operand dtype);
+    ``interpret`` forces the interpret-mode kernel — the CPU parity/
+    dryrun path.
     """
+    mode = kernel if kernel is not None else _ring_kernel_mode()
+    if mode not in KERNEL_MODES:
+        raise ValueError("unknown ring kernel mode %r — valid: %s" %
+                         (mode, list(KERNEL_MODES)))
+    itp = bool(interpret)
+    if _try_ring_flash(q, k, mode, itp):
+        return _ring_flash(q, k, v, axis_name, causal,
+                           attention_compute_dtype(precision), itp)
     n = lax.psum(1, axis_name)
     rank = lax.axis_index(axis_name)
     B, Sq, H, D = q.shape
-    dt = attention_compute_dtype()
+    dt = attention_compute_dtype(precision)
     scale = 1.0 / (D ** 0.5)
     q_offset = rank * Sq
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -374,7 +500,8 @@ def _gathered_attention(q, k, v, causal):
 
 def sequence_parallel_attention(q, k, v, mesh, seq_axis,
                                 causal=False, batch_axis=None,
-                                mode="ring", head_axis=None):
+                                mode="ring", head_axis=None,
+                                kernel=None, interpret=None):
     """Wraps a sequence-parallel attention (``mode``: "ring" →
     :func:`ring_attention`, "ulysses" → :func:`ulysses_attention`) in
     ``shard_map`` over the mesh's sequence axis (activations
@@ -386,7 +513,11 @@ def sequence_parallel_attention(q, k, v, mesh, seq_axis,
     ``seq_axis``); ``head_axis`` keeps the head dim TENSOR-parallel
     (dp × tp × sp composes: attention is per-head, so a Megatron
     head shard rotates only its own heads' k/v around the ring —
-    no model-axis collective is ever needed inside)."""
+    no model-axis collective is ever needed inside, and the
+    ring-flash body sees only the local heads' (B, S/N, H/ntp, D)
+    shard).  ``kernel``/``interpret`` reach the ring body only
+    (:func:`ring_attention`'s ring-flash dispatch); Ulysses keeps
+    its knob-driven local attention."""
     import inspect
     try:
         from jax import shard_map
@@ -409,8 +540,12 @@ def sequence_parallel_attention(q, k, v, mesh, seq_axis,
         raise ValueError("unknown sequence-parallel mode %r — "
                          "valid: %s" % (mode, sorted(modes)))
     inner = modes[mode]
+    inner_kw = {"axis_name": seq_axis, "causal": causal}
+    if mode == "ring":
+        inner_kw["kernel"] = kernel
+        inner_kw["interpret"] = interpret
     fn = shard_map(
-        functools.partial(inner, axis_name=seq_axis, causal=causal),
+        functools.partial(inner, **inner_kw),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         **_kw)
     return fn(q, k, v)

@@ -22,18 +22,44 @@ choices, for exactly one geometry family:
     produces dk/dv gridded over key blocks — no atomics, no
     cross-block races.
 
+Since ISSUE 13 the kernel is RESUMABLE and MULTI-CHIP-composable:
+
+  * the public contract carries the running softmax statistics — the
+    forward returns ``(out, lse)`` and the custom VJP accepts an lse
+    cotangent (dL/ds gains a ``+ g_lse·p`` term, folded into the
+    existing delta row for free: ``delta' = Σ dO·O − g_lse``), so a
+    caller may hold partial results open across kernel invocations;
+  * :func:`flash_chunk` runs one partial over a k/v CHUNK with
+    GLOBAL causal offsets (the ring streams shards whose true
+    positions the kernel must mask by — offsets arrive as traced
+    scalars, (1, 1) i32 operands read inside the kernel, because a
+    ring step's source rank is data-dependent under ``shard_map``);
+  * :func:`merge_partials` folds two partials by lse —
+    ``lse = logaddexp(lse₁, lse₂); out = Σᵢ exp(lseᵢ − lse)·outᵢ``
+    — the exact streaming-softmax combine, every exponent ≤ 0 so the
+    merge is unconditionally stable; :func:`flash_resume` is the
+    carry-shaped wrapper (``(out, lse)`` IS the ``(acc, m, l)``
+    triple in collapsed form: ``out = acc/l``, ``lse = m + log l``);
+  * :func:`pallas_decode_attention` is the decode-shaped variant
+    (S_q ∈ 1..``DECODE_MAX_Q``): a k/v-SPLIT grid over the gathered
+    paged table — each program owns one key block, emits its partial
+    ``(out, lse)``, and a cross-block lse merge combines them — so
+    serving's one-token steps ride the kernel without a VMEM-whole
+    sequence bound (forward-only; decode has no backward).
+
 Like pallas_lrn.py, the module ships three layers: the kernel, a
 reference-parity fallback (ops/attention.blockwise_attention — the
-parity oracle the tests pin), and an availability probe so dispatch
-(ops/attention._try_pallas) degrades silently off-TPU.
+parity oracle the tests pin), and availability probes so dispatch
+(ops/attention._try_pallas, the ring body, export's decode gate)
+degrades silently off-TPU.
 
 HBM-traffic budget at the bench geometry (B=8, S=1024, H=16, D=128):
 q/k/v/o are 64 MB each in f32; the fwd reads q/k/v once and writes
 o + lse ≈ 0.26 GB, the bwd reads them + do and writes dq/dk/dv ≈
 0.45 GB — ~0.9 ms at 819 GB/s vs the 6.4 GB (7.8 ms) the XLA
 formulation moves through its materialized f32 score/probability
-tensors.  That 8× traffic cut is the whole thesis; BENCHNOTES r6
-carries the A/B protocol (``bench.py --lm --attn-stages=...``).
+tensors.  That 8× traffic cut is the whole thesis; BENCHNOTES r6/r9
+carry the A/B protocol (``bench.py --lm --attn-stages=...``).
 """
 
 import functools
@@ -60,6 +86,14 @@ LANE = 128
 #: in 16 MB; past it the tiles stop fitting and dispatch must fall
 #: back to the streaming scan instead of dying in the compiler.
 MAX_SEQ = 2048
+
+#: Decode-kernel query bound: past this many query rows the chunk is
+#: a prefill, which the full flash kernel (or the dense cached path)
+#: serves better than a split-k/v decode launch.
+DECODE_MAX_Q = 16
+#: Decode key-block default: the split-k/v grid step over the
+#: gathered paged table.
+DEFAULT_DECODE_BLOCK_K = 512
 
 
 def _pick_block(n, want):
@@ -90,19 +124,77 @@ def supports(q_shape, k_shape, kv_len=None):
     return True
 
 
+def supports_ring(q_shape, k_shape, interpret=False):
+    """The :func:`flash_chunk` geometry contract — one ring step's
+    local queries against one streamed k/v shard.  Unlike
+    :func:`supports` the q and k lengths may differ (a ring over an
+    uneven composition could stream shards of another extent), but
+    batch/heads/head-dim must agree.  ``interpret`` relaxes the
+    lane/tile alignment: the interpret kernel is plain jax ops, so
+    the tiny tier-1 geometries (D=4, S=8 shards) are parity-testable
+    on CPU while compiled dispatch keeps the real-TPU tile contract.
+    """
+    if len(q_shape) != 4 or len(k_shape) != 4:
+        return False
+    B, Sq, H, D = q_shape
+    Bk, Sk, Hk, Dk = k_shape
+    if (B, H, D) != (Bk, Hk, Dk):
+        return False
+    if Sq < 1 or Sk < 1:
+        return False
+    if interpret:
+        return True
+    if D % LANE or D > 4 * LANE:
+        return False
+    for S in (Sq, Sk):
+        if S % LANE or S < LANE or S > MAX_SEQ:
+            return False
+    return True
+
+
+def supports_decode(q_shape, k_shape, interpret=False):
+    """The :func:`pallas_decode_attention` contract: a small query
+    chunk (S_q ≤ ``DECODE_MAX_Q`` — decode steps, not prefills)
+    against a long gathered key table.  The table has NO ``MAX_SEQ``
+    bound — the split-k/v grid streams it block by block instead of
+    holding it whole in VMEM.  ``interpret`` relaxes tile alignment
+    exactly as in :func:`supports_ring`."""
+    if len(q_shape) != 4 or len(k_shape) != 4:
+        return False
+    B, Sq, H, D = q_shape
+    Bk, L, Hk, Dk = k_shape
+    if (B, H, D) != (Bk, Hk, Dk):
+        return False
+    if not 1 <= Sq <= DECODE_MAX_Q:
+        return False
+    if L < 1:
+        return False
+    if interpret:
+        return True
+    if D % LANE or D > 4 * LANE:
+        return False
+    if L % LANE:
+        return False
+    return True
+
+
 # -- kernels -------------------------------------------------------------
 
 
-def _mask_tile(rows0, cols0, bq, bk, causal, kv_len):
-    """(bq, bk) boolean attend-mask for the tile whose global row/col
-    origins are rows0/cols0, or None when nothing masks."""
+def _mask_tile(grows0, gcols0, lcols0, bq, bk, causal, kv_len):
+    """(bq, bk) boolean attend-mask for one score tile, or None when
+    nothing masks.  Causality is judged on GLOBAL positions (row/col
+    origins ``grows0``/``gcols0`` — possibly traced scalars: the ring
+    offsets are data-dependent), while the ``kv_len`` padding bound
+    applies to the chunk's LOCAL columns (origin ``lcols0``) — it is
+    the caller's own padding, wherever the chunk sits globally."""
     mask = None
     if causal:
-        rows = rows0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        cols = cols0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        rows = grows0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = gcols0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         mask = rows >= cols
     if kv_len is not None:
-        cols = cols0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        cols = lcols0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         kvm = cols < kv_len
         mask = kvm if mask is None else jnp.logical_and(mask, kvm)
     return mask
@@ -116,23 +208,25 @@ def _dot(a, b, od, trans_b=False):
                                preferred_element_type=jnp.float32)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                causal, kv_len, block_k, seq_len, od):
+def _fwd_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref,
+                lse_ref, *, scale, causal, kv_len, block_k,
+                kv_seq_len, od):
     from jax.experimental import pallas as pl
     bq = q_ref.shape[1]
     D = q_ref.shape[2]
     i = pl.program_id(1)
     q = q_ref[0]
-    q_off = i * bq
-    nk = seq_len // block_k
+    grows0 = qoff_ref[0, 0] + i * bq
+    koff = koff_ref[0, 0]
+    nk = kv_seq_len // block_k
 
     def body(j, carry):
         acc, m, l = carry
         kb = k_ref[0, pl.ds(j * block_k, block_k), :]
         vb = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = _dot(q, kb, od, trans_b=True) * scale
-        mask = _mask_tile(q_off, j * block_k, bq, block_k, causal,
-                          kv_len)
+        mask = _mask_tile(grows0, koff + j * block_k, j * block_k,
+                          bq, block_k, causal, kv_len)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         bm = s.max(axis=1, keepdims=True)
@@ -155,12 +249,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     # Fully-masked rows keep m = NEG_INF so lse ≈ -1e30 (finite, not
     # -inf); the bwd kernels do NOT rely on exp(s - lse) underflowing
     # for such rows — they re-mask p with jnp.where before use.
+    # Finite lse is also what makes the cross-chunk merge total: a
+    # chunk a row attends nothing in contributes weight exp(-1e30 -
+    # lse_total) = 0, never NaN.
     lse_ref[0, :] = (m + jnp.log(l_safe))[:, 0]
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, scale, causal, kv_len, block_k, seq_len,
-               od):
+def _dq_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+               lse_ref, delta_ref, dq_ref, *, scale, causal, kv_len,
+               block_k, kv_seq_len, od):
     from jax.experimental import pallas as pl
     bq = q_ref.shape[1]
     D = q_ref.shape[2]
@@ -169,15 +266,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     do = do_ref[0]
     lse = lse_ref[0, :][:, None]
     delta = delta_ref[0, :][:, None]
-    q_off = i * bq
-    nk = seq_len // block_k
+    grows0 = qoff_ref[0, 0] + i * bq
+    koff = koff_ref[0, 0]
+    nk = kv_seq_len // block_k
 
     def body(j, dq):
         kb = k_ref[0, pl.ds(j * block_k, block_k), :]
         vb = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = _dot(q, kb, od, trans_b=True) * scale
-        mask = _mask_tile(q_off, j * block_k, bq, block_k, causal,
-                          kv_len)
+        mask = _mask_tile(grows0, koff + j * block_k, j * block_k,
+                          bq, block_k, causal, kv_len)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -192,17 +290,19 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         jnp.zeros((bq, D), jnp.float32)).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, kv_len, block_q,
-                seq_len, od):
+def _dkv_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+                lse_ref, delta_ref, dk_ref, dv_ref, *, scale, causal,
+                kv_len, block_q, q_seq_len, od):
     from jax.experimental import pallas as pl
     bk = k_ref.shape[1]
     D = k_ref.shape[2]
     j = pl.program_id(1)
     k = k_ref[0]
     v = v_ref[0]
-    k_off = j * bk
-    nq = seq_len // block_q
+    qoff = qoff_ref[0, 0]
+    gcols0 = koff_ref[0, 0] + j * bk
+    lcols0 = j * bk
+    nq = q_seq_len // block_q
 
     def body(i, carry):
         dk, dv = carry
@@ -211,8 +311,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
         delta = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
         s = _dot(qb, k, od, trans_b=True) * scale
-        mask = _mask_tile(i * block_q, k_off, block_q, bk, causal,
-                          kv_len)
+        mask = _mask_tile(qoff + i * block_q, gcols0, lcols0,
+                          block_q, bk, causal, kv_len)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
@@ -253,73 +353,101 @@ def _vec_spec(block, which):
     return pl.BlockSpec((1, block), lambda b, i: (b, 0))
 
 
-def _flash_fwd_flat(qf, kf, vf, causal, kv_len, bq, bk, od,
-                    interpret):
-    """(BH, S, D) forward: returns (out, lse)."""
+def _off_spec():
+    """BlockSpec for the (1, 1) i32 global-offset operands: every
+    program reads the same scalar (the ring's shard origin is
+    data-dependent, so it cannot be a static kernel parameter)."""
     from jax.experimental import pallas as pl
-    BH, S, D = qf.shape
+    return pl.BlockSpec((1, 1), lambda b, i: (0, 0))
+
+
+def _off_operand(off):
+    """Traced-or-static offset → the (1, 1) i32 kernel operand.
+    Offsets cross the custom-VJP boundary as (1, 1) f32 — rank ≥ 1
+    because shard_map's autodiff cannot carry a device-varying
+    RANK-0 residual (the ring's offsets depend on axis_index), and
+    f32 so the cotangent contract stays float (exact for any
+    realistic sequence position)."""
+    return jnp.asarray(off, jnp.int32).reshape(1, 1)
+
+
+def _flash_fwd_flat(qf, kf, vf, qoff, koff, causal, kv_len, bq, bk,
+                    od, interpret):
+    """(BH, Sq, D) × (BH, Sk, D) forward: returns (out, lse)."""
+    from jax.experimental import pallas as pl
+    BH, Sq, D = qf.shape
+    Sk = kf.shape[1]
     scale = 1.0 / (D ** 0.5)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             kv_len=kv_len, block_k=bk, seq_len=S,
-                             od=od)
+                             kv_len=kv_len, block_k=bk,
+                             kv_seq_len=Sk, od=od)
     return pl.pallas_call(
         kern,
-        grid=(BH, S // bq),
-        in_specs=[_row_spec(bq, D, "blocked"),
-                  _row_spec(S, D, "whole"),
-                  _row_spec(S, D, "whole")],
+        grid=(BH, Sq // bq),
+        in_specs=[_off_spec(), _off_spec(),
+                  _row_spec(bq, D, "blocked"),
+                  _row_spec(Sk, D, "whole"),
+                  _row_spec(Sk, D, "whole")],
         out_specs=(_row_spec(bq, D, "blocked"),
                    _vec_spec(bq, "blocked")),
-        out_shape=(jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
-                   jax.ShapeDtypeStruct((BH, S), jnp.float32)),
+        out_shape=(jax.ShapeDtypeStruct((BH, Sq, D), qf.dtype),
+                   jax.ShapeDtypeStruct((BH, Sq), jnp.float32)),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(_off_operand(qoff), _off_operand(koff), qf, kf, vf)
 
 
-def _flash_bwd_flat(qf, kf, vf, of, dof, lse, causal, kv_len, bq, bk,
-                    od, interpret):
+def _flash_bwd_flat(qf, kf, vf, of, dof, lse, dlse, qoff, koff,
+                    causal, kv_len, bq, bk, od, interpret):
     from jax.experimental import pallas as pl
-    BH, S, D = qf.shape
+    BH, Sq, D = qf.shape
+    Sk = kf.shape[1]
     scale = 1.0 / (D ** 0.5)
-    # delta_i = Σ_d dO·O — tiny elementwise pass, left to XLA.
+    # delta_i = Σ_d dO·O − g_lse: the lse cotangent rides the same
+    # per-row correction term (dL/ds_j = p_j·(dp_j − delta + g_lse)),
+    # so lifting lse into the public contract costs the kernels
+    # NOTHING — tiny elementwise pass, left to XLA.
     delta = (dof.astype(jnp.float32) *
-             of.astype(jnp.float32)).sum(axis=-1)
+             of.astype(jnp.float32)).sum(axis=-1) - \
+        dlse.astype(jnp.float32)
+    offs = (_off_operand(qoff), _off_operand(koff))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          kv_len=kv_len, block_k=bk, seq_len=S,
+                          kv_len=kv_len, block_k=bk, kv_seq_len=Sk,
                           od=od),
-        grid=(BH, S // bq),
-        in_specs=[_row_spec(bq, D, "blocked"),
-                  _row_spec(S, D, "whole"),
-                  _row_spec(S, D, "whole"),
+        grid=(BH, Sq // bq),
+        in_specs=[_off_spec(), _off_spec(),
+                  _row_spec(bq, D, "blocked"),
+                  _row_spec(Sk, D, "whole"),
+                  _row_spec(Sk, D, "whole"),
                   _row_spec(bq, D, "blocked"),
                   _vec_spec(bq, "blocked"),
                   _vec_spec(bq, "blocked")],
         out_specs=_row_spec(bq, D, "blocked"),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), qf.dtype),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(*offs, qf, kf, vf, dof, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          kv_len=kv_len, block_q=bq, seq_len=S,
+                          kv_len=kv_len, block_q=bq, q_seq_len=Sq,
                           od=od),
-        grid=(BH, S // bk),
-        in_specs=[_row_spec(S, D, "whole"),
+        grid=(BH, Sk // bk),
+        in_specs=[_off_spec(), _off_spec(),
+                  _row_spec(Sq, D, "whole"),
                   _row_spec(bk, D, "blocked"),
                   _row_spec(bk, D, "blocked"),
-                  _row_spec(S, D, "whole"),
-                  _vec_spec(S, "whole"),
-                  _vec_spec(S, "whole")],
+                  _row_spec(Sq, D, "whole"),
+                  _vec_spec(Sq, "whole"),
+                  _vec_spec(Sq, "whole")],
         out_specs=(_row_spec(bk, D, "blocked"),
                    _row_spec(bk, D, "blocked")),
-        out_shape=(jax.ShapeDtypeStruct((BH, S, D), qf.dtype),
-                   jax.ShapeDtypeStruct((BH, S, D), qf.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((BH, Sk, D), qf.dtype),
+                   jax.ShapeDtypeStruct((BH, Sk, D), qf.dtype)),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(*offs, qf, kf, vf, dof, lse, delta)
     return dq, dk, dv
 
 
-# -- differentiable (B, S, H, D) entry point -----------------------------
+# -- differentiable (B, S, H, D) entry points ----------------------------
 
 
 def _to_flat(x):
@@ -332,31 +460,54 @@ def _from_flat(xf, B, H):
     return xf.reshape(B, H, S, D).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, kv_len, bq, bk, od, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, kv_len, bq, bk, od,
-                        interpret)
-    return out
+def _lse_from_flat(lf, B, H):
+    BH, S = lf.shape
+    return lf.reshape(B, H, S).transpose(0, 2, 1)
 
 
-def _flash_fwd(q, k, v, causal, kv_len, bq, bk, od, interpret):
-    B, S, H, D = q.shape
-    of, lse = _flash_fwd_flat(_to_flat(q), _to_flat(k), _to_flat(v),
-                              causal, kv_len, bq, bk, od, interpret)
-    return _from_flat(of, B, H), (q, k, v, _from_flat(of, B, H), lse)
+def _lse_to_flat(l):
+    B, S, H = l.shape
+    return l.transpose(0, 2, 1).reshape(B * H, S)
 
 
-def _flash_bwd(causal, kv_len, bq, bk, od, interpret, res, do):
-    q, k, v, out, lse = res
-    B, S, H, D = q.shape
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9,
+                                                    10))
+def _flash_lse(q, k, v, qoff, koff, causal, kv_len, bq, bk, od,
+               interpret):
+    """The lse-carrying flash core: (out, lse) with a backward that
+    recomputes probabilities from the saved lse.  ``qoff``/``koff``
+    are (1, 1) f32 arrays (global causal origins, possibly traced —
+    see :func:`_off_operand` for the shape/dtype contract)."""
+    out, lse = _flash_lse_fwd(q, k, v, qoff, koff, causal, kv_len,
+                              bq, bk, od, interpret)[0]
+    return out, lse
+
+
+def _flash_lse_fwd(q, k, v, qoff, koff, causal, kv_len, bq, bk, od,
+                   interpret):
+    B, Sq, H, D = q.shape
+    of, lsef = _flash_fwd_flat(_to_flat(q), _to_flat(k), _to_flat(v),
+                               qoff, koff, causal, kv_len, bq, bk,
+                               od, interpret)
+    out = _from_flat(of, B, H)
+    lse = _lse_from_flat(lsef, B, H)
+    return (out, lse), (q, k, v, out, lsef, qoff, koff)
+
+
+def _flash_lse_bwd(causal, kv_len, bq, bk, od, interpret, res, ct):
+    q, k, v, out, lsef, qoff, koff = res
+    do, dlse = ct
+    B, Sq, H, D = q.shape
     dqf, dkf, dvf = _flash_bwd_flat(
         _to_flat(q), _to_flat(k), _to_flat(v), _to_flat(out),
-        _to_flat(do), lse, causal, kv_len, bq, bk, od, interpret)
+        _to_flat(do), lsef, _lse_to_flat(dlse), qoff, koff, causal,
+        kv_len, bq, bk, od, interpret)
     return (_from_flat(dqf, B, H), _from_flat(dkf, B, H),
-            _from_flat(dvf, B, H))
+            _from_flat(dvf, B, H), jnp.zeros((1, 1), jnp.float32),
+            jnp.zeros((1, 1), jnp.float32))
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def pallas_attention(q, k, v, causal=False, kv_len=None, block_q=None,
@@ -379,14 +530,172 @@ def pallas_attention(q, k, v, causal=False, kv_len=None, block_q=None,
     bk = _pick_block(S, block_k or DEFAULT_BLOCK_K)
     od = jnp.dtype(operand_dtype or jnp.bfloat16).type
     if kv_len is not None:
-        kv_len = int(kv_len)
-    return _flash(q, k, v, bool(causal), kv_len, bq, bk, od,
-                  bool(interpret))
+        # Static by the supports() contract (isinstance(int) gate).
+        kv_len = int(kv_len)  # lint-ok: VL101 static config int
+    zero = jnp.zeros((1, 1), jnp.float32)
+    out, _lse = _flash_lse(q, k, v, zero, zero, bool(causal),
+                           kv_len, bq, bk, od, bool(interpret))
+    return out
+
+
+# -- the resumable (ring) contract ---------------------------------------
+
+
+def flash_chunk(q, k, v, causal=False, q_offset=0, k_offset=0,
+                kv_len=None, block_q=None, block_k=None,
+                operand_dtype=None, interpret=False):
+    """ONE flash partial: local queries (B, Sq, H, D) against one
+    k/v chunk (B, Sk, H, D) whose global positions start at
+    ``k_offset`` (queries at ``q_offset``) — the ring-attention step
+    body.  Returns ``(out, lse)`` with ``out`` the chunk-normalized
+    partial and ``lse`` (B, Sq, H) f32 its log-normalizer; fold
+    partials with :func:`merge_partials`.  Offsets may be TRACED
+    scalars (a ring step's source rank is data-dependent inside
+    ``shard_map``).  Differentiable: the backward recomputes
+    probabilities from lse per chunk (dq/dkv kernels), and the lse
+    output's own cotangent folds into the delta row — so autodiff
+    through a chunk+merge composition is exact, no custom ring VJP
+    needed."""
+    if not supports_ring(q.shape, k.shape, interpret=interpret):
+        raise ValueError(
+            "geometry (%s × %s) outside the flash_chunk contract — "
+            "use ops.attention's streaming formulations" %
+            (q.shape, k.shape))
+    Sq, Sk = q.shape[1], k.shape[1]
+    bq = _pick_block(Sq, block_q or DEFAULT_BLOCK_Q)
+    bk = _pick_block(Sk, block_k or DEFAULT_BLOCK_K)
+    od = jnp.dtype(operand_dtype or jnp.bfloat16).type
+    if kv_len is not None:
+        # Static padding bound, never traced (supports_ring path).
+        kv_len = int(kv_len)  # lint-ok: VL101 static config int
+    qoff = jnp.asarray(q_offset, jnp.float32).reshape(1, 1)
+    koff = jnp.asarray(k_offset, jnp.float32).reshape(1, 1)
+    return _flash_lse(q, k, v, qoff, koff, bool(causal), kv_len, bq,
+                      bk, od, bool(interpret))
+
+
+def merge_partials(o1, lse1, o2, lse2):
+    """Folds two flash partials by lse:
+    ``lse = logaddexp(lse₁, lse₂)``;
+    ``out = exp(lse₁ − lse)·o₁ + exp(lse₂ − lse)·o₂``.
+    Every exponent is ≤ 0, so the merge is unconditionally stable,
+    and a void partial (lse ≈ −1e30 from a fully-masked chunk)
+    contributes weight exp(−1e30 − lse) = 0 — finite, never NaN.
+    Associative and commutative: any merge tree over the ring steps
+    produces the same softmax."""
+    lse1 = lse1.astype(jnp.float32)
+    lse2 = lse2.astype(jnp.float32)
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse)[..., None]
+    w2 = jnp.exp(lse2 - lse)[..., None]
+    out = (o1.astype(jnp.float32) * w1 +
+           o2.astype(jnp.float32) * w2).astype(o1.dtype)
+    return out, lse
+
+
+def flash_resume(carry, q, k, v, **kwargs):
+    """The carry-shaped resumable entry: folds one more k/v chunk
+    into a running ``(out, lse)`` carry (None starts one).  The
+    carry IS the streaming-softmax ``(acc, m, l)`` state in
+    collapsed form — ``out = acc/l``, ``lse = m + log l`` — which is
+    the only shape the cross-chunk combine needs.  The carried
+    partial is HELD f32 whatever the chunk dtype (the merge's output
+    dtype follows its first operand): a bf16 activation stream must
+    round once when the caller finishes, not once per folded chunk —
+    the single-accumulator discipline the lax streaming scan keeps.
+    kwargs are :func:`flash_chunk`'s."""
+    o_i, lse_i = flash_chunk(q, k, v, **kwargs)
+    if carry is None:
+        return o_i.astype(jnp.float32), lse_i
+    return merge_partials(carry[0], carry[1], o_i, lse_i)
+
+
+# -- the decode-shaped kernel --------------------------------------------
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                   scale, od):
+    """One key block's flash partial for a tiny query chunk: the
+    grid splits the KEY axis (each program owns one block of the
+    gathered paged table), and the cross-block combine happens
+    outside by lse merge — no carried state between programs, so the
+    launch parallelizes over (B, H, key blocks) instead of
+    serializing a fori_loop nobody amortizes at S_q = 1."""
+    q = q_ref[0, 0]
+    kb = k_ref[0, 0]
+    vb = v_ref[0, 0]
+    s = _dot(q, kb, od, trans_b=True) * scale
+    mask = mask_ref[0] != 0
+    s = jnp.where(mask, s, NEG_INF)
+    m = s.max(axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask, p, 0.0)
+    l = p.sum(axis=1, keepdims=True)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0, 0] = (_dot(p, vb, od) / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0, 0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def pallas_decode_attention(q, k, v, key_mask, block_k=None,
+                            operand_dtype=None, interpret=False):
+    """Flash-decode over a gathered key table: q (B, Sq, H, D) with
+    Sq ≤ ``DECODE_MAX_Q``, k/v (B, L, H, D), ``key_mask`` (B, Sq, L)
+    True = attend (the serving paths' per-row valid-slot masks —
+    causality, pad slots, and table trash all arrive through it).
+    Grid (B, H, L/block_k): every program emits its block's partial
+    (out, lse) and a cross-block lse merge combines them.  Forward
+    only — decode never backpropagates.  Masked slots are exact
+    zeros after the merge and real keys keep their relative order,
+    the same exactness argument as the dense paged path."""
+    if not supports_decode(q.shape, k.shape, interpret=interpret):
+        raise ValueError(
+            "geometry (%s × %s) outside the decode-kernel contract "
+            "— serve through the dense cached path" %
+            (q.shape, k.shape))
+    from jax.experimental import pallas as pl
+    B, Sq, H, D = q.shape
+    L = k.shape[1]
+    bk = _pick_block(L, block_k or DEFAULT_DECODE_BLOCK_K)
+    nk = L // bk
+    od = jnp.dtype(operand_dtype or jnp.bfloat16).type
+    scale = 1.0 / (D ** 0.5)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    mask = key_mask.astype(jnp.int32)
+    o_part, lse_part = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, od=od),
+        grid=(B, H, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, Sq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, Sq, bk), lambda b, h, j: (b, 0, j)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, 1, Sq, D),
+                         lambda b, h, j: (b, h, j, 0, 0)),
+            pl.BlockSpec((1, 1, 1, Sq),
+                         lambda b, h, j: (b, h, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, H, nk, Sq, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, nk, Sq), jnp.float32),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt, mask)
+    # Cross-block lse merge (the flash-decode combine): weights are
+    # exp(lse_i − lse_total) ≤ 1, void blocks weigh 0.
+    lse = jax.nn.logsumexp(lse_part, axis=2)
+    w = jnp.exp(lse_part - lse[:, :, None, :])
+    out = (o_part * w[..., None]).sum(axis=2)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 # -- availability --------------------------------------------------------
 
 _available = [None]
+_decode_available = [None]
 
 
 def pallas_attention_available():
@@ -415,6 +724,37 @@ def pallas_attention_available():
     return _available[0]
 
 
+def pallas_decode_available():
+    """End-to-end probe for the decode-shaped kernel (its split-k/v
+    grid and 5-d output tiling are a different lowering than the
+    training kernel's, so it gets its own cached verdict)."""
+    if _decode_available[0] is None:
+        from .pallas_lrn import tpu_available
+        if not tpu_available():
+            _decode_available[0] = False
+        else:
+            try:
+                q = jnp.zeros((1, 1, 1, LANE), jnp.float32)
+                kv = jnp.zeros((1, LANE, 1, LANE), jnp.float32)
+                mask = jnp.ones((1, 1, LANE), bool)
+                # f32 operands: the probe must gate the LOWERING the
+                # serving path actually runs (export._decode_attend
+                # pins operand_dtype=f32), not the bf16 default.
+                jax.block_until_ready(
+                    pallas_decode_attention(
+                        q, kv, kv, mask,
+                        operand_dtype=jnp.float32))
+                _decode_available[0] = True
+            except Exception as e:
+                import logging
+                logging.getLogger("pallas_attention").info(
+                    "decode kernel probe failed (%s) — dense "
+                    "fallback", e)
+                _decode_available[0] = False
+    return _decode_available[0]
+
+
 def reset_probe():
-    """Clears the cached availability probe (tests, backend swaps)."""
+    """Clears the cached availability probes (tests, backend swaps)."""
     _available[0] = None
+    _decode_available[0] = None

@@ -294,7 +294,8 @@ def apply_dp_tp_sharding(workflow, mesh, data_axis="data",
 
 
 def apply_dp_tp_sp_sharding(workflow, mesh, data_axis="data",
-                            model_axis="model", seq_axis="seq"):
+                            model_axis="model", seq_axis="seq",
+                            sp_kernel=None):
     """COMPOSED 3-axis layout: data × tensor × sequence parallelism.
 
     The Megatron column/row weight sharding comes from
@@ -304,7 +305,12 @@ def apply_dp_tp_sp_sharding(workflow, mesh, data_axis="data",
     specs now carry the model axis on the HEAD dim — attention is
     per-head, so head-sharding composes with the sequence collectives
     for free: the ring's ppermutes involve only ``seq_axis``, each
-    model shard rotates only its own heads' k/v.
+    model shard rotates only its own heads' k/v — and the ring-flash
+    body (``sp_ring_kernel`` "auto" default) runs the Pallas kernel
+    on exactly that local-heads shard, so tp × sp × flash composes
+    with no extra collective.  ``sp_kernel`` overrides the knob on
+    every sequence-parallel unit ("xla" forces the lax scan,
+    "pallas" the flash body — the dryrun's self-verify handle).
 
     Mesh shape: (data, model, seq).  Activations (B, S, H, D) inside
     attention are sharded (data, seq, model, None).
@@ -319,6 +325,8 @@ def apply_dp_tp_sp_sharding(workflow, mesh, data_axis="data",
         unit.batch_axis = data_axis
         if getattr(unit, "n_heads", 0) % n_model == 0:
             unit.head_axis = model_axis
+        if sp_kernel is not None:
+            unit.sp_kernel = sp_kernel
         sp_blocks += 1
     if sp_blocks == 0:
         workflow.warning(

@@ -221,10 +221,6 @@ class Server(Logger):
         self.respawn = kwargs.get("respawn")
         self.max_respawns = int(kwargs.get("max_respawns", 10))
         self._respawn_counts = {}  # guarded-by: _lock
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True,
-            name="veles-server-accept")
-        self._accept_thread.start()
         self._watchdog_interval = kwargs.get("watchdog_interval", 1.0)
         #: Floor for the adaptive timeout (reference: server.py:624
         #: floors it at a job_timeout defaulting to 2 minutes).  With
@@ -241,6 +237,17 @@ class Server(Logger):
             config_get(root.common.server.blacklist_cooldown, 60.0)))
         #: machine id -> wall time of its latest blacklisting.
         self._blacklist = {}  # guarded-by: _lock
+        # Threads LAST, accept included: the socket is bound above,
+        # so a worker hammering reconnects (the chaos restart loop)
+        # can dial the instant the port exists — its handler must
+        # never observe a half-constructed server (a pre-ISSUE-13
+        # flake: _serve_slave read self._blacklist before __init__
+        # assigned it and the AttributeError read as a master-side
+        # failure, stopping the coordinator mid-chaos-plan).
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="veles-server-accept")
+        self._accept_thread.start()
         self._watchdog_thread = threading.Thread(
             target=self._watchdog_loop, daemon=True,
             name="veles-server-watchdog")
@@ -520,6 +527,24 @@ class Server(Logger):
             # A crashed master does NOT requeue or respawn — it is
             # dead; cleanup is the restarted master's job.
             if desc is not None and not self._crashed:
+                if not clean and self._stop.is_set() and \
+                        self.failure is None:
+                    with self._lock:
+                        finished = self._finished_locked()
+                else:
+                    finished = False
+                if finished:
+                    # Orderly-completion race: ONE handler observes
+                    # the finished run, sends its peer the bye and
+                    # stops the coordinator; every OTHER live session
+                    # (and this one, when its own bye send raced the
+                    # teardown) then unwinds through a closed socket
+                    # or the _stop flag.  Training completed and the
+                    # master is healthy, so this is a retirement, not
+                    # a drop — _drop still demotes it to drop+requeue
+                    # if the worker holds in-flight work, keeping
+                    # ``server.drop`` a pure error signal both ways.
+                    clean = True
                 self._drop(desc, clean=clean)
 
     def _message_loop(self, chan, desc):

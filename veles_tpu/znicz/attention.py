@@ -267,6 +267,14 @@ class TransformerBlock(ForwardBase):
         #: head dim sharded on this mesh axis inside the shard_map —
         #: the tp × sp composition.
         self.head_axis = kwargs.get("head_axis")
+        #: Ring-kernel override for the sequence-parallel path:
+        #: None → the ``sp_ring_kernel`` knob ("auto" default —
+        #: ring-flash where the platform supports it); "xla" forces
+        #: the lax streaming scan; "pallas" forces the flash body.
+        self.sp_kernel = kwargs.get("sp_kernel")
+        #: Forces the interpret-mode flash kernel inside the ring —
+        #: the CPU parity/dryrun path (tests only; never on a chip).
+        self.sp_interpret = kwargs.get("sp_interpret")
         #: None → follow root.common.engine.remat; True/False forces.
         self.remat = kwargs.get("remat")
         #: Resolved at construction (None → the engine knob) so the
@@ -315,7 +323,9 @@ class TransformerBlock(ForwardBase):
             return A.sequence_parallel_attention(
                 q, k, v, mesh, self.seq_axis, causal=self.causal,
                 batch_axis=self.batch_axis, mode=self.sp_mode,
-                head_axis=getattr(self, "head_axis", None))
+                head_axis=getattr(self, "head_axis", None),
+                kernel=getattr(self, "sp_kernel", None),
+                interpret=getattr(self, "sp_interpret", None))
         return A.attention(q, k, v, causal=self.causal)
 
     def tforward(self, read, write, params, ctx, state=None):

@@ -61,7 +61,7 @@ class TinyLMWorkflow(AcceleratedWorkflow):
                  embed_dim=32, n_heads=4, n_blocks=1,
                  minibatch_size=64, learning_rate=0.01,
                  gradient_moment=0.9, max_epochs=8, seq_axis=None,
-                 sp_mode="ring",
+                 sp_mode="ring", sp_kernel=None, sp_interpret=None,
                  n_experts=0, expert_axis=None, top_k=None,
                  router_z_weight=None, pipelined=False,
                  stage_axis=None, n_microbatches=4, schedule=None,
@@ -107,6 +107,7 @@ class TinyLMWorkflow(AcceleratedWorkflow):
                 block = MoETransformerBlock(
                     self, n_heads=n_heads, causal=True,
                     seq_axis=seq_axis, sp_mode=sp_mode,
+                    sp_kernel=sp_kernel, sp_interpret=sp_interpret,
                     n_experts=n_experts, top_k=top_k,
                     router_z_weight=router_z_weight,
                     fused_qkv=fused_qkv, expert_axis=expert_axis,
@@ -121,6 +122,7 @@ class TinyLMWorkflow(AcceleratedWorkflow):
                 block = TransformerBlock(
                     self, n_heads=n_heads, causal=True,
                     seq_axis=seq_axis, sp_mode=sp_mode,
+                    sp_kernel=sp_kernel, sp_interpret=sp_interpret,
                     fused_qkv=fused_qkv, name="block%d" % i)
             block.link_from(prev)
             block.input = prev.output
