@@ -39,6 +39,15 @@ occupancy, and 429 sheds past pool exhaustion — first through the
 paged decode-step continuous-batching path, then the same workload
 through whole-request batching (``vs_baseline`` = paged/dense tok/s).
 
+``python bench.py --serve --spec`` runs the speculative-decoding A/B
+(BENCH_r10) instead: the same engine over a REPETITIVE-text workload
+(small-scale weights — greedy continuations collapse into cycles,
+the prompt-lookup-favorable regime), measured three ways — spec off,
+n-gram drafting at fixed K, n-gram with per-row adaptive K — and
+reports tok/s per mode, accept rate, tokens/step, draft/verify
+latencies, and rewound blocks; ``vs_baseline`` is adaptive-spec over
+plain paged decode on the same workload.
+
 ``python bench.py --streamed-jpeg`` decodes REAL JPEG files (a
 synthetic directory tree written once) through the streamed loader's
 host worker pool — decode + double-buffered upload + fused dispatch
@@ -76,9 +85,9 @@ A100_MLP_IMG_PER_SEC = 1.5e6
 #: exist here or in a real parser.
 BENCH_FLAGS = ("--mlp", "--lm", "--lm-toy", "--serve", "--streamed",
                "--streamed-jpeg", "--attn-stages", "--attn-ladder",
-               "--serve-streams", "--serve-seconds", "--trace-out",
-               "--optimizer", "--pp-schedule", "--moe-topk",
-               "--moe-experts")
+               "--serve-streams", "--serve-seconds", "--spec",
+               "--trace-out", "--optimizer", "--pp-schedule",
+               "--moe-topk", "--moe-experts")
 
 # Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
 # or blocks gain <3% more.  The perf levers that got here: banded-
@@ -196,23 +205,43 @@ SERVE_NEW_CHOICES = (8, 16, 24, 40, 64)
 #: the prefix cache has something to share.
 SERVE_SHARED_PREFIX = 32
 
+#: ``--serve --spec`` A/B: the REPETITIVE-text workload — near-zero
+#: attention/positional weights make the next token a deterministic
+#: function of the current one, so greedy continuations cycle (the
+#: deterministic-continuation limit of extractive/copy/summary
+#: traffic, the prompt-lookup-favorable regime) — with long decode
+#: budgets so drafting has a stream to ride.
+SERVE_SPEC_ATTN_SCALE = 0.002
+SERVE_SPEC_K = 8
+SERVE_SPEC_PROMPT_CHOICES = (8, 16, 24)
+SERVE_SPEC_NEW_CHOICES = (48, 64, 96)
 
-def build_serve_artifact(path):
+
+def build_serve_artifact(path, scale=0.5, attn_scale=1.0):
     """Writes a randomly-weighted causal-LM artifact (embedding →
     blocks → lm_head) without training — serving economics do not
-    depend on the weights."""
+    depend on the weights.  ``attn_scale`` < 1 shapes the TEXT the
+    model emits: shrinking the attention/positional weights makes
+    the next token a (near-)deterministic function of the current
+    one, so greedy continuations fall into cycles — guaranteed
+    REPETITIVE text, the n-gram-drafter-favorable regime the --spec
+    A/B measures (the deterministic-continuation limit of
+    extractive/copy/summary traffic).  The attention math still
+    runs at full cost either way."""
     import io
     import tarfile
     import numpy
     from veles_tpu.json_encoders import dumps_json
     rng = numpy.random.RandomState(1234)
+    attn_names = ("wq", "bq", "wk", "bk", "wv", "bv", "wo", "bo")
 
-    def g(*shape):
-        return (rng.standard_normal(shape) * 0.5).astype(
+    def g(*shape, extra=1.0):
+        return (rng.standard_normal(shape) * scale * extra).astype(
             numpy.float32)
 
     weights = {"emb__weights": g(SERVE_VOCAB, SERVE_EMBED),
-               "emb__pos": g(SERVE_POS, SERVE_EMBED)}
+               "emb__pos": g(SERVE_POS, SERVE_EMBED,
+                             extra=attn_scale)}
     units = [{"name": "emb", "type": "embedding",
               "config": {"vocab_size": SERVE_VOCAB,
                          "embed_dim": SERVE_EMBED},
@@ -232,7 +261,9 @@ def build_serve_artifact(path):
                 ("w2", (H, E)), ("b2", (E,))]:
             key = "%s__%s" % (name, pname)
             weights[key] = numpy.ones(shape, numpy.float32) \
-                if pname.endswith("_g") else g(*shape)
+                if pname.endswith("_g") else \
+                g(*shape, extra=attn_scale
+                  if pname in attn_names else 1.0)
             params[pname] = key
         units.append({"name": name, "type": "transformer_block",
                       "config": {"n_heads": SERVE_HEADS,
@@ -260,7 +291,9 @@ def build_serve_artifact(path):
     return path
 
 
-def run_serve_load(engine, streams, seconds, seed=0):
+def run_serve_load(engine, streams, seconds, seed=0,
+                   prompt_choices=SERVE_PROMPT_CHOICES,
+                   new_choices=SERVE_NEW_CHOICES):
     """Drives ``streams`` concurrent client threads against the
     engine in-process for ``seconds``; returns aggregate client-side
     numbers (the engine's ServingStats carries the server-side
@@ -279,8 +312,8 @@ def run_serve_load(engine, streams, seconds, seed=0):
     def stream(idx):
         rng = numpy.random.RandomState(seed * 1000 + idx)
         while time.monotonic() < stop_at:
-            s = int(rng.choice(SERVE_PROMPT_CHOICES))
-            m = int(rng.choice(SERVE_NEW_CHOICES))
+            s = int(rng.choice(prompt_choices))
+            m = int(rng.choice(new_choices))
             prompt = rng.randint(0, SERVE_VOCAB, (1, s)) \
                 .astype(numpy.int32)
             if idx < SERVE_SHARED_PREFIX and s >= 48:
@@ -344,6 +377,7 @@ def serve_bench(argv):
     from veles_tpu.serving import ServingEngine
     streams = SERVE_STREAMS
     seconds = SERVE_SECONDS
+    spec_ab = "--spec" in argv
     for arg in argv:
         if arg.startswith("--serve-streams="):
             streams = int(arg.split("=", 1)[1])
@@ -351,9 +385,16 @@ def serve_bench(argv):
             seconds = float(arg.split("=", 1)[1])
     path = os.path.join(tempfile.gettempdir(),
                         "veles_serve_bench.veles.tgz")
-    build_serve_artifact(path)
+    build_serve_artifact(
+        path, scale=0.5,
+        attn_scale=SERVE_SPEC_ATTN_SCALE if spec_ab else 1.0)
 
-    def one_mode(paged, kv_blocks=None):
+    prompts = SERVE_SPEC_PROMPT_CHOICES if spec_ab else \
+        SERVE_PROMPT_CHOICES
+    news = SERVE_SPEC_NEW_CHOICES if spec_ab else SERVE_NEW_CHOICES
+
+    def one_mode(paged, kv_blocks=None, spec=False,
+                 spec_adaptive=True):
         from veles_tpu.serving import BucketPolicy
         model = ExportedModel(path, compile_capacity=256)
         engine = ServingEngine(
@@ -366,19 +407,25 @@ def serve_bench(argv):
                                 batch_floor=8,
                                 prompt_cap=SERVE_POS),
             paged=paged, kv_blocks=kv_blocks,
-            kv_block_size=SERVE_KV_BLOCK)
+            kv_block_size=SERVE_KV_BLOCK,
+            spec=spec, spec_max_k=SERVE_SPEC_K,
+            spec_adaptive=spec_adaptive)
         engine.start()
         try:
-            engine.warmup(
-                longest_prompt=max(SERVE_PROMPT_CHOICES),
-                max_new=max(SERVE_NEW_CHOICES))
-            totals = run_serve_load(engine, streams, seconds)
+            engine.warmup(longest_prompt=max(prompts),
+                          max_new=max(news))
+            totals = run_serve_load(engine, streams, seconds,
+                                    prompt_choices=prompts,
+                                    new_choices=news)
             snap = engine.stats.snapshot()
             pool = engine.kv_pool
             occ = pool.occupancy() if pool is not None else {}
         finally:
             engine.stop()
         return totals, snap, occ
+
+    if spec_ab:
+        return serve_spec_ab(one_mode, streams, seconds)
 
     # The paged pool is deliberately sized BELOW the worst case
     # (max_batch full-length rows) so the soak drives it past
@@ -420,6 +467,76 @@ def serve_bench(argv):
         "kv_prefix_hits": occ.get("prefix_hits"),
         "kv_cow_copies": occ.get("cow_copies"),
         "dense_tok_per_sec": round(dense_tps, 1),
+    }))
+
+
+def serve_spec_ab(one_mode, streams, seconds):
+    """``--serve --spec``: the speculative-decoding A/B on a
+    repetitive-text workload (BENCH_r10) — spec off / n-gram at
+    fixed K / n-gram with adaptive K, same artifact, same mixed
+    geometry, pool sized to the worst case so the ratio measures
+    DECODE, not shedding."""
+    per_row = -(-(max(SERVE_SPEC_PROMPT_CHOICES) +
+                  max(SERVE_SPEC_NEW_CHOICES)) // SERVE_KV_BLOCK)
+    # Worst-case reservations for every concurrent STREAM (queued
+    # requests hold commits too): the A/B measures decode, never
+    # shedding.
+    kv_blocks = streams * per_row + 1 + 16
+    off_t, off_s, _ = one_mode(True, kv_blocks)
+    fix_t, fix_s, _ = one_mode(True, kv_blocks, spec=True,
+                               spec_adaptive=False)
+    ada_t, ada_s, occ = one_mode(True, kv_blocks, spec=True,
+                                 spec_adaptive=True)
+    off_tps = off_t["tokens"] / max(off_t["wall"], 1e-9)
+    fix_tps = fix_t["tokens"] / max(fix_t["wall"], 1e-9)
+    ada_tps = ada_t["tokens"] / max(ada_t["wall"], 1e-9)
+
+    def pct(snap, key, p):
+        lat = snap["latency"].get(key) or {}
+        return lat.get("p%d_ms" % p)
+
+    def gauges(snap):
+        g = snap.get("gauges", {})
+        return {"accept_rate": g.get("spec.accept_rate"),
+                "tokens_per_step": g.get("spec.tokens_per_step"),
+                "mean_accepted_len": g.get("spec.mean_accepted_len"),
+                "draft_ms": g.get("spec.draft_ms"),
+                "verify_ms": g.get("spec.verify_ms")}
+
+    print(json.dumps({
+        "metric": "serve_spec_decode_tok_per_sec",
+        "value": round(ada_tps, 1),
+        "unit": "tokens/sec",
+        # vs_baseline = adaptive-K speculative vs plain paged decode
+        # on the SAME repetitive workload — the acceptance gate is
+        # strictly > 1.0.
+        "vs_baseline": round(ada_tps / max(off_tps, 1e-9), 4),
+        "vs_baseline_meaning": "spec_adaptive_vs_plain_tok_per_sec",
+        "streams": streams,
+        "seconds": seconds,
+        "spec_max_k": SERVE_SPEC_K,
+        "attn_scale": SERVE_SPEC_ATTN_SCALE,
+        "plain_tok_per_sec": round(off_tps, 1),
+        "ngram_fixed_tok_per_sec": round(fix_tps, 1),
+        "ngram_adaptive_tok_per_sec": round(ada_tps, 1),
+        "ngram_fixed_vs_plain": round(
+            fix_tps / max(off_tps, 1e-9), 4),
+        "spec_fixed": gauges(fix_s),
+        "spec_adaptive": gauges(ada_s),
+        "itl_p50_ms_plain": pct(off_s, "itl.decode", 50),
+        "itl_p50_ms_spec": pct(ada_s, "itl.decode", 50),
+        "itl_p99_ms_plain": pct(off_s, "itl.decode", 99),
+        "itl_p99_ms_spec": pct(ada_s, "itl.decode", 99),
+        "requests": {"plain": off_t["requests"],
+                     "fixed": fix_t["requests"],
+                     "adaptive": ada_t["requests"]},
+        "errors": off_t["errors"] + fix_t["errors"] +
+        ada_t["errors"],
+        "kv_blocks": kv_blocks,
+        "kv_pool_peak_blocks": ada_t["pool_peak"],
+        "spec_rewound_blocks":
+            ada_s["counters"].get("spec.rewound_blocks", 0),
+        "kv_prefix_hits": occ.get("prefix_hits"),
     }))
 
 

@@ -120,6 +120,7 @@ class PagedFakeModel(object):
         self.prefill_delay = prefill_delay
         self.extend_shapes = []  # (B, T, Sc)
         self.step_shapes = []    # (B, T)
+        self.verify_shapes = []  # (B, K+1)
         self._lock = threading.Lock()
 
     def make_kv_pool(self, n_blocks, block_size=16):
@@ -149,6 +150,19 @@ class PagedFakeModel(object):
         if self.step_delay:
             time.sleep(self.step_delay)
         return (numpy.asarray(tok) + 1) % 97
+
+    def paged_verify(self, pool, tables, pos, toks, draft_lens,
+                     gen_idx, temps, seeds):
+        """Speculative verify with the same per-row fingerprint:
+        the target's token at column j is (fed token at j) + 1 —
+        so a drafter proposing the +1 chain is fully accepted and
+        any other proposal is rejected at its first wrong token."""
+        toks = numpy.asarray(toks)
+        with self._lock:
+            self.verify_shapes.append(toks.shape)
+        if self.step_delay:
+            time.sleep(self.step_delay)
+        return (toks + 1) % 97
 
 
 def _expected_forward(x):

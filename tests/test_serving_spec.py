@@ -1,0 +1,550 @@
+"""Speculative decoding on the paged continuous-batching engine
+(ISSUE 14): drafters, the acceptance rule, one-pass multi-token
+verify, adaptive K, block-table rewind, and the strict-step /
+drain-estimate guardrails.
+
+The two acceptance gates ride here: greedy speculative decode is
+TOKEN-IDENTICAL to plain paged decode (which PR 6 proved identical
+to dense ``generate_bucketed`` — the oracle chain), and the sampled
+acceptance rule matches a numpy rejection-sampling oracle.
+"""
+
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu.error import Bug
+from veles_tpu.export import ExportedModel
+from veles_tpu.serving import ServingEngine
+from veles_tpu.serving.speculation import (NGramDrafter, SpecState,
+                                           accept_lengths,
+                                           check_draft_compat)
+
+from test_serving import (PagedFakeModel, _expected_generated,
+                          _random_lm_artifact)
+
+
+# -- drafters and the acceptance rule (pure host units) --------------------
+
+
+def test_ngram_drafter_proposes_history_continuation():
+    d = NGramDrafter(max_n=3, min_n=1)
+    ctx = numpy.array([5, 6, 7, 8, 5, 6, 7], numpy.int32)
+    # Trailing 3-gram [5, 6, 7] occurred at 0; its continuation is
+    # [8, 5, 6].
+    numpy.testing.assert_array_equal(
+        d.propose(ctx, len(ctx), 3), [8, 5, 6])
+    # k bounds the proposal.
+    numpy.testing.assert_array_equal(
+        d.propose(ctx, len(ctx), 1), [8])
+    # No earlier occurrence of any trailing n-gram: no proposal.
+    fresh = numpy.array([1, 2, 3, 4], numpy.int32)
+    assert d.propose(fresh, len(fresh), 4).size == 0
+    # Only the filled prefix of the buffer is history.
+    padded = numpy.array([5, 6, 5, 6, 0, 0, 0, 0], numpy.int32)
+    numpy.testing.assert_array_equal(
+        d.propose(padded, 4, 2), [5, 6])
+
+
+def test_accept_lengths_longest_prefix_rule():
+    drafts = numpy.array([[4, 5, 6],
+                          [4, 5, 6],
+                          [9, 5, 6],
+                          [4, 5, 6]], numpy.int32)
+    dlens = numpy.array([3, 3, 3, 2])
+    # Target output per position (K+1 = 4 columns, last = bonus).
+    targets = numpy.array([[4, 5, 6, 7],    # all accepted
+                           [4, 5, 0, 7],    # 2 accepted
+                           [4, 5, 6, 7],    # first draft wrong
+                           [4, 5, 6, 7]],   # dlens clamps to 2
+                          numpy.int32)
+    numpy.testing.assert_array_equal(
+        accept_lengths(drafts, dlens, targets), [3, 2, 0, 2])
+
+
+def test_sampled_acceptance_matches_rejection_sampling_oracle():
+    """Statistical gate: for a point-mass (deterministic) draft, the
+    implemented rule — accept while the target's own sample equals
+    the draft, else emit the target's sample — must reproduce the
+    Leviathan speculative-sampling law: accept x with probability
+    p(x), and on rejection emit from the corrected residual
+    ``norm(max(0, p - q))`` = p conditioned on != x."""
+    rng = numpy.random.RandomState(42)
+    p = numpy.array([0.5, 0.3, 0.15, 0.05])
+    draft_tok = 0
+    n = 20000
+    # The engine-side rule, driven through accept_lengths: targets
+    # are the verify program's per-position samples ~ p.
+    target0 = rng.choice(4, size=n, p=p)
+    bonus = rng.choice(4, size=n, p=p)  # next-position sample
+    targets = numpy.stack([target0, bonus], axis=1)
+    drafts = numpy.full((n, 1), draft_tok, numpy.int32)
+    acc = accept_lengths(drafts, numpy.ones(n, numpy.int64), targets)
+    emitted = numpy.where(acc == 1, draft_tok, target0)
+    accept_rate = float((acc == 1).mean())
+    # Numpy rejection-sampling oracle (the Leviathan rule).
+    u = rng.rand(n)
+    residual = p.copy()
+    residual[draft_tok] = 0.0
+    residual /= residual.sum()
+    oracle = numpy.where(
+        u < p[draft_tok], draft_tok,
+        rng.choice(4, size=n, p=residual))
+    # Acceptance probability is p(x) for both.
+    assert abs(accept_rate - p[draft_tok]) < 0.02
+    assert abs(float((oracle == draft_tok).mean()) -
+               p[draft_tok]) < 0.02
+    # Emitted-token distributions agree (both are exactly p).
+    got = numpy.bincount(emitted, minlength=4) / float(n)
+    want = numpy.bincount(oracle, minlength=4) / float(n)
+    assert numpy.abs(got - want).max() < 0.02
+    assert numpy.abs(got - p).max() < 0.02
+    # Conditioned on rejection, the emitted token follows the
+    # corrected residual — never the rejected draft.
+    rejected = emitted[acc == 0]
+    assert (rejected != draft_tok).all()
+    rej_hist = numpy.bincount(rejected, minlength=4) / \
+        float(max(len(rejected), 1))
+    assert numpy.abs(rej_hist - residual).max() < 0.03
+
+
+def test_adaptive_k_decays_and_probes():
+    st = SpecState(4, capacity=64)
+    assert st.budget(4, True) == 4  # optimistic start
+    for _ in range(12):
+        st.update(0, 4, 4, True)  # every draft rejected
+    assert st.k == 0
+    # At K == 0 the row decodes plain, with ONE periodic probe per
+    # PROBE_AFTER plain steps.
+    probes = [st.budget(4, True)
+              for _ in range(SpecState.PROBE_AFTER + 1)]
+    assert probes.count(1) == 1
+    assert probes.index(1) == SpecState.PROBE_AFTER - 1
+    # Acceptance recovers K.
+    for _ in range(12):
+        st.update(4, 4, 4, True)
+    assert st.k == 4
+    # Non-adaptive mode pins K.
+    st2 = SpecState(3, capacity=8)
+    st2.update(0, 3, 3, False)
+    assert st2.budget(3, False) == 3
+
+
+# -- token identity on the real artifact (the tier-1 gates) ----------------
+
+
+@pytest.fixture(scope="module")
+def spec_lm(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("spec") / "spec.veles.tgz")
+    model = ExportedModel(_random_lm_artifact(path))
+    model._test_artifact_path = path
+    return model
+
+
+def _spec_engine(model, **kw):
+    defaults = dict(max_batch=4, kv_blocks=32, kv_block_size=4,
+                    spec=True, spec_max_k=3)
+    defaults.update(kw)
+    return ServingEngine(model, **defaults)
+
+
+def test_spec_greedy_token_identical_to_plain_decode(spec_lm):
+    """THE acceptance gate: greedy decode with n-gram speculation —
+    drafting, one-pass verify, rewind, adaptive K — is
+    TOKEN-IDENTICAL to the proven non-speculative program, across
+    concurrently coalesced rows of different lengths, and drafts
+    really are accepted (the untrained LM's repetitive
+    continuations are exactly the prompt-lookup-favorable case)."""
+    model = spec_lm
+    rng = numpy.random.RandomState(7)
+    lengths = [2, 5, 8]
+    prompts = numpy.zeros((3, 8), numpy.int32)
+    rows = []
+    for i, length in enumerate(lengths):
+        p = rng.randint(0, 13, (1, length)).astype(numpy.int32)
+        prompts[i, :length] = p[0]
+        rows.append(p)
+    ref = model.generate_bucketed(prompts, lengths, 8)
+    engine = _spec_engine(model).start()
+    try:
+        out = {}
+
+        def gen(i):
+            out[i] = engine.submit_generate(rows[i], 8)
+
+        threads = [threading.Thread(target=gen, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, length in enumerate(lengths):
+            numpy.testing.assert_array_equal(
+                out[i][0, length:], ref[i])
+        snap = engine.stats.snapshot()
+        c = snap["counters"]
+        assert c.get("batches.verify", 0) >= 1
+        assert c.get("spec.accepted", 0) >= 1
+        assert snap["gauges"]["spec.accept_rate"] > 0
+        assert snap["gauges"]["spec.tokens_per_step"] > 1.0
+        # The heartbeat serving summary (launcher → web_status row)
+        # carries the speculative gauges — the PR-8 weight_version
+        # wiring pattern.
+        from veles_tpu.serving.metrics import live_serving_summary
+        summary = live_serving_summary()
+        assert summary is not None
+        assert summary["spec_accept_rate"] > 0
+        assert summary["spec_tokens_per_step"] > 1.0
+        # All rows retired: only prefix-cache entries hold blocks.
+        engine.kv_pool.drop_prefixes()
+        assert engine.kv_pool.occupancy()["blocks_used"] == 0
+    finally:
+        engine.stop()
+
+
+def test_spec_sampled_token_identical_to_plain_streams(spec_lm):
+    """Sampled speculation draws the SAME per-row PRNG streams as
+    the non-speculative path (fold index = generation index), so
+    sampled output is bit-identical too — the strongest form of the
+    acceptance-rule guarantee.  (Same geometry as the greedy gate —
+    the programs are compile-cache hits.)"""
+    model = spec_lm
+    rng = numpy.random.RandomState(7)
+    lengths = [2, 5, 8]
+    prompts = numpy.zeros((3, 8), numpy.int32)
+    rows = []
+    for i, length in enumerate(lengths):
+        p = rng.randint(0, 13, (1, length)).astype(numpy.int32)
+        prompts[i, :length] = p[0]
+        rows.append(p)
+    ref = model.generate_bucketed(prompts, lengths, 8,
+                                  temperatures=1.1,
+                                  seeds=numpy.array([5, 6, 7]))
+    engine = _spec_engine(model).start()
+    try:
+        for i, length in enumerate(lengths):
+            o = engine.submit_generate(rows[i], 8, temperature=1.1,
+                                       seed=5 + i)
+            numpy.testing.assert_array_equal(o[0, length:], ref[i])
+    finally:
+        engine.stop()
+
+
+def test_spec_draft_model_drafter_and_reload(spec_lm, tmp_path):
+    """The draft-model drafter with draft == target: every greedy
+    proposal matches the target's own stream, so acceptance is
+    total, output identical, and the draft pool pays K cheap steps
+    per expensive verify.  The draft also rides the export/reload
+    chain: a same-geometry artifact hot-swaps in place, an
+    incompatible one is rejected with the old draft still
+    proposing."""
+    model = spec_lm
+    draft = ExportedModel(model._test_artifact_path)
+    prompt = numpy.array([[7, 3, 1, 4, 1]], numpy.int32)
+    padded = numpy.zeros((3, 8), numpy.int32)
+    padded[:, :5] = prompt[0]
+    # 3 identical rows: reuses the bucket program the greedy gate
+    # compiled (tier-1 compile budget).
+    ref = model.generate_bucketed(padded, [5, 5, 5], 8)
+    engine = _spec_engine(model, spec=False,
+                          spec_draft=draft).start()
+    try:
+        assert engine.spec_mode == "draft"
+        out = engine.submit_generate(prompt, 8)
+        numpy.testing.assert_array_equal(out[0, 5:], ref[0])
+        c = engine.stats.snapshot()["counters"]
+        assert c.get("spec.drafted", 0) >= 1
+        assert c["spec.accepted"] == c["spec.drafted"]
+        assert c.get("spec.draft_faults", 0) == 0
+        # Draft-pool hygiene: mirrors released with their rows.
+        assert engine.draft_pool.occupancy()["blocks_used"] == 0
+        # Hot draft reload, in place (same geometry).
+        engine.reload_draft(model._test_artifact_path)
+        assert engine.stats.get("spec.draft_reloads") == 1
+        bad = str(tmp_path / "badvocab.veles.tgz")
+        _random_lm_artifact(bad, vocab=7)
+        with pytest.raises(Bug, match="vocabulary mismatch"):
+            engine.reload_draft(bad)
+        # The old draft still proposes; decode still speculates.
+        before = engine.stats.get("spec.accepted")
+        out = engine.submit_generate(prompt, 8)
+        numpy.testing.assert_array_equal(out[0, 5:], ref[0])
+        assert engine.stats.get("spec.accepted") > before
+        # A draft fault degrades to the n-gram drafter — and a
+        # successful draft reload RECOVERS draft-model drafting.
+        engine._degrade_draft()
+        assert engine.spec_mode == "ngram"
+        assert engine.stats.get("spec.draft_faults") == 1
+        engine.reload_draft(model._test_artifact_path)
+        assert engine.spec_mode == "draft"
+        assert engine.draft_pool is not None
+        out = engine.submit_generate(prompt, 8)
+        numpy.testing.assert_array_equal(out[0, 5:], ref[0])
+        assert engine.stats.get("spec.accepted") > before
+    finally:
+        engine.stop()
+
+
+def test_draft_compat_gate(spec_lm, tmp_path):
+    """A draft over a different vocabulary is refused at LOAD, like
+    a bad swap_weights — not discovered as garbage mid-stream."""
+    other = ExportedModel(_random_lm_artifact(
+        str(tmp_path / "othervocab.veles.tgz"), vocab=7))
+    with pytest.raises(Bug, match="vocabulary mismatch"):
+        check_draft_compat(spec_lm, other)
+    with pytest.raises(Bug, match="vocabulary mismatch"):
+        ServingEngine(spec_lm, max_batch=4, kv_blocks=32,
+                      kv_block_size=4, spec_draft=other)
+    # Same vocab, smaller geometry: compatible.
+    small = ExportedModel(_random_lm_artifact(
+        str(tmp_path / "smalldraft.veles.tgz"), embed=4, hidden=8,
+        seed=3))
+    check_draft_compat(spec_lm, small)
+
+
+# -- scheduler behavior on the fake paged model ----------------------------
+
+
+class _WrongDrafter(object):
+    """Adversarial drafter: proposes tokens the fake model's target
+    stream never emits (its chain is +1 mod 97; 95 is two behind),
+    so every draft is rejected."""
+
+    def propose(self, ctx, n_ctx, k):
+        return numpy.full(int(k), 95, numpy.int32)
+
+
+class _ChainDrafter(object):
+    """Oracle drafter for PagedFakeModel: proposes the +1 chain the
+    fake target always emits, so every draft is accepted."""
+
+    def propose(self, ctx, n_ctx, k):
+        last = int(ctx[n_ctx - 1])
+        return ((last + 1 + numpy.arange(int(k))) % 97) \
+            .astype(numpy.int32)
+
+
+def _fake_spec_engine(model, drafter, **kw):
+    defaults = dict(max_batch=4, kv_blocks=64, kv_block_size=8,
+                    spec=True, spec_max_k=3)
+    defaults.update(kw)
+    engine = ServingEngine(model, **defaults)
+    engine._drafter = drafter
+    return engine
+
+
+def test_spec_mixed_rows_join_retire_and_verify_batches():
+    """Mixed spec/non-spec rows share the loop: an accepting row
+    rides multi-token verify dispatches while a rejecting row backs
+    off to plain steps, a late request joins mid-flight, everyone's
+    output keeps the per-row fingerprint, and early retirement
+    still frees blocks immediately."""
+    model = PagedFakeModel(step_delay=0.01)
+    engine = _fake_spec_engine(model, _ChainDrafter()).start()
+    try:
+        done = {}
+
+        def run(name, prompt, n):
+            out = engine.submit_generate(prompt, n)
+            done[name] = (time.monotonic(), out)
+
+        long_p = numpy.array([[9, 9, 9]], numpy.int32)
+        t_long = threading.Thread(
+            target=run, args=("long", long_p, 60))
+        t_long.start()
+        time.sleep(0.05)  # decoding (speculatively) by now
+        short_p = numpy.array([[5, 7]], numpy.int32)
+        run("short", short_p, 4)
+        t_long.join()
+        assert done["short"][0] < done["long"][0]
+        numpy.testing.assert_array_equal(
+            done["short"][1][0, 2:],
+            _expected_generated(short_p[0], 4))
+        numpy.testing.assert_array_equal(
+            done["long"][1][0, 3:],
+            _expected_generated(long_p[0], 60))
+        c = engine.stats.snapshot()["counters"]
+        assert c.get("batches.verify", 0) >= 2
+        # Speculation needed FEWER dispatches than tokens: the whole
+        # point.  60 + 4 = 64 tokens in well under 64 decode
+        # dispatches (fully-accepting drafts ⇒ ~K+1 per verify).
+        dispatches = c.get("batches.verify", 0) + \
+            c.get("batches.decode", 0)
+        assert dispatches < 40
+        assert c["tokens.generated"] == 64
+    finally:
+        engine.stop()
+
+
+def test_spec_adaptive_k_backs_off_adversarial_stream():
+    """An adversarial (never-matching) stream must degrade to plain
+    decode: rejected rounds drive the acceptance EWMA down, K hits
+    0, and verify dispatches stop while the stream still completes
+    correctly — and the 'decode' batch-cost EWMA stays keyed apart
+    from 'verify', so Retry-After quotes for non-spec clients are
+    not poisoned by speculative dispatch costs."""
+    model = PagedFakeModel(step_delay=0.002)
+    engine = _fake_spec_engine(model, _WrongDrafter()).start()
+    try:
+        prompt = numpy.array([[11, 12]], numpy.int32)
+        out = engine.submit_generate(prompt, 30)
+        numpy.testing.assert_array_equal(
+            out[0, 2:], _expected_generated(prompt[0], 30))
+        c = engine.stats.snapshot()["counters"]
+        assert c.get("spec.accepted", 0) == 0
+        assert c.get("batches.verify", 0) >= 1
+        # Backoff: far fewer verify rounds than decode steps.
+        assert c["batches.verify"] < c["batches.decode"]
+        snap = engine.stats.snapshot()
+        assert snap["gauges"]["spec.accept_rate"] < 0.2
+        # The EWMAs are keyed per dispatch kind.
+        with engine._cond:
+            assert "verify" in engine._batch_ewma
+            assert "decode" in engine._batch_ewma
+    finally:
+        engine.stop()
+
+
+def test_spec_rewind_frees_rejected_blocks():
+    """Block-table rewind: a rejected draft span whose blocks were
+    grown for the verify write-ahead returns those whole blocks to
+    the pool at the same boundary (block size 1 ⇒ every rejected
+    draft position is its own block), and accounting balances."""
+    model = PagedFakeModel(step_delay=0.002)
+    engine = _fake_spec_engine(model, _WrongDrafter(),
+                               kv_blocks=128, kv_block_size=1,
+                               spec_adaptive=False).start()
+    try:
+        prompt = numpy.array([[11, 12]], numpy.int32)
+        out = engine.submit_generate(prompt, 10)
+        numpy.testing.assert_array_equal(
+            out[0, 2:], _expected_generated(prompt[0], 10))
+        c = engine.stats.snapshot()["counters"]
+        # Each rejected round grew blocks for the 3-draft span and
+        # released the ones past the (kept) next-write block — at
+        # least one whole block back per round at block size 1.
+        assert c.get("spec.rewound_blocks", 0) >= \
+            c.get("spec.rounds", 0) > 0
+        # Retired rows release everything; only the prompt's cached
+        # full-block prefixes (block size 1 ⇒ both tokens) remain.
+        engine.kv_pool.drop_prefixes()
+        assert engine.kv_pool.occupancy()["blocks_used"] == 0
+    finally:
+        engine.stop()
+
+
+def test_spec_tail_block_cow_unshares_before_write():
+    """The rewind/growth path's write-discipline guard: when the
+    block the next write lands in is held by anyone else, the
+    engine copy-on-writes it first (pool accounting asserts) — the
+    same COW rule prefix adoption follows."""
+    model = PagedFakeModel(step_delay=0.01)
+    engine = _fake_spec_engine(model, _ChainDrafter(),
+                               kv_blocks=64,
+                               kv_block_size=4).start()
+    try:
+        grabbed = []
+
+        def grab_tail():
+            # Simulate a second owner of the row's tail block the
+            # moment the row appears (what a future tail-sharing
+            # scheme would create).
+            for _ in range(200):
+                with engine._cond:
+                    rows = list(engine._rows)
+                if rows and rows[0].table:
+                    blk = rows[0].table[-1]
+                    engine.kv_pool.retain([blk])
+                    grabbed.append(blk)
+                    return
+                time.sleep(0.005)
+
+        t = threading.Thread(target=grab_tail)
+        t.start()
+        prompt = numpy.array([[3, 4]], numpy.int32)
+        out = engine.submit_generate(prompt, 24)
+        t.join()
+        numpy.testing.assert_array_equal(
+            out[0, 2:], _expected_generated(prompt[0], 24))
+        assert grabbed, "the probe never saw the live row"
+        occ = engine.kv_pool.occupancy()
+        assert occ["cow_copies"] >= 1
+        engine.kv_pool.release(grabbed)
+        assert engine.kv_pool.occupancy()["blocks_used"] == 0
+    finally:
+        engine.stop()
+
+
+def test_lazy_tables_hold_fewer_blocks_than_worst_case():
+    """Lazy allocation: mid-decode a row holds blocks for tokens
+    that EXIST, not its worst-case budget — the pool-efficiency win
+    speculation's rewind rides on."""
+    model = PagedFakeModel(step_delay=0.02)
+    engine = ServingEngine(model, max_batch=2, kv_blocks=64,
+                           kv_block_size=1).start()
+    try:
+        seen = []
+
+        def sample():
+            for _ in range(40):
+                seen.append(
+                    engine.kv_pool.occupancy()["blocks_used"])
+                time.sleep(0.01)
+
+        t = threading.Thread(target=sample)
+        t.start()
+        prompt = numpy.array([[1, 2]], numpy.int32)
+        out = engine.submit_generate(prompt, 40)
+        t.join()
+        numpy.testing.assert_array_equal(
+            out[0, 2:], _expected_generated(prompt[0], 40))
+        worst = 2 + 40  # prompt + budget blocks at block size 1
+        assert max(seen) > 0
+        assert min(v for v in seen if v > 0) < worst // 2
+    finally:
+        engine.stop()
+
+
+def test_drain_estimate_not_poisoned_by_verify_costs():
+    """The satellite bugfix: batch-cost EWMAs are keyed on DISPATCH
+    kind, so an expensive speculative verify never inflates the
+    Retry-After a queued non-spec client is quoted."""
+    from veles_tpu.serving.engine import _Request
+    engine = ServingEngine(PagedFakeModel(), max_batch=4,
+                           kv_blocks=64, kv_block_size=8)
+    engine._note_ewma("verify", 30.0)   # pathological verify cost
+    engine._note_ewma("generate", 0.05)
+    engine._note_ewma("decode", 0.02)
+    req = _Request("generate", ("pg",), 1, None)
+    with engine._cond:
+        engine._paged_wait.append(req)
+        est = engine._drain_estimate_locked()
+        engine._paged_wait.clear()
+    assert est < 2.0, est
+
+
+def test_strict_step_spec_decode_loop(spec_lm):
+    """Perf guardrail (satellite): after warmup the SPECULATIVE hot
+    loop — host-side n-gram drafting, verify dispatch, rewind — runs
+    under strict_step with zero implicit transfers and zero compile
+    misses.  (Rides the shared module artifact: most programs are
+    already compiled, and strict_step checks the MISS accounting on
+    this model's own cache regardless.)"""
+    from veles_tpu.analysis import runtime
+    model = spec_lm
+    # SAME pool geometry as the other spec engines: pool geometry is
+    # part of every compile key, and a different one would recompile
+    # the whole program family just for this test.
+    engine = _spec_engine(model, default_deadline=60.0).start()
+    try:
+        rng = numpy.random.RandomState(0)
+        prompt = rng.randint(0, 13, (1, 6)).astype(numpy.int32)
+        warm = engine.submit_generate(prompt, 8)
+        with runtime.strict_step():
+            again = engine.submit_generate(prompt, 8)
+        numpy.testing.assert_array_equal(warm, again)
+        assert engine.stats.get("batches.verify") >= 1
+    finally:
+        engine.stop()

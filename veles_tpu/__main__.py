@@ -306,6 +306,15 @@ class Main(Logger, CommandLineBase):
                 args.serve_kv_block_size
         if args.serve_no_paged:
             root.common.serving.paged = False
+        if args.serve_spec:
+            root.common.serving.spec = True
+        if args.serve_spec_draft is not None:
+            root.common.serving.spec_draft = args.serve_spec_draft
+        if args.serve_spec_max_k is not None:
+            root.common.serving.spec_max_k = args.serve_spec_max_k
+        if args.serve_spec_draft_blocks is not None:
+            root.common.serving.spec_draft_blocks = \
+                args.serve_spec_draft_blocks
         if args.serve_drain_timeout is not None:
             root.common.serving.drain_timeout = \
                 args.serve_drain_timeout

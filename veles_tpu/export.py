@@ -435,6 +435,14 @@ class KVBlockPool(object):
         with self._lock:
             self._release_locked(ids)
 
+    def refs_of(self, block_id):
+        """Current refcount of one block (0 for free/trash) — the
+        speculative-decode rewind path asks before writing into a
+        table tail block whether anyone else holds it (refs > 1 ⇒
+        copy-on-write first, exactly the prefix-sharing discipline)."""
+        with self._lock:
+            return self._refs.get(int(block_id), 0)
+
     def _release_locked(self, ids):
         for b in ids:
             if b == self.TRASH:
@@ -1779,6 +1787,14 @@ class ExportedModel(object):
             wpos = jnp.clip(prior[:, None] + offs[None, :], 0,
                             S_keys - 1)
             wblock = jnp.take_along_axis(tables, wpos // bs, axis=1)
+            # Pad columns past each row's true chunk write to the
+            # TRASH block explicitly: tables now cover exactly the
+            # row's real span (lazy allocation), so the positional
+            # clip above can land a junk column ON a real slot —
+            # and a scatter collision with a real write is
+            # update-order-undefined.
+            wblock = jnp.where(offs[None, :] < chunk_len[:, None],
+                               wblock, KVBlockPool.TRASH)
             wslot = wpos % bs
             qpos = prior[:, None] + offs[None, :]
             key_mask = (jnp.arange(S_keys)[None, None, :] <=
@@ -1850,6 +1866,131 @@ class ExportedModel(object):
             return new_pks, new_pvs, tok_new
 
         return jax.jit(run, donate_argnums=(1, 2))
+
+    def _build_paged_verify(self, K, T, block_size):
+        """Jitted speculative-verify step over the block pool: each
+        row feeds its current token PLUS ``K`` draft tokens as one
+        ``K+1``-position chunk at positions ``pos..pos+K`` (k/v
+        scattered through the table exactly like a prefill chunk),
+        attends the pool under the per-position causal mask, and
+        SAMPLES the target's token at EVERY chunk position — column
+        ``j`` with PRNG fold index ``gen_idx + j``, the same per-row
+        stream ``_build_paged_step`` would use at that generation
+        index.  The caller compares column ``j``'s output against
+        draft ``j+1`` host-side: the longest matching prefix is
+        accepted and the first non-matching output is the bonus
+        token, so greedy decode is BIT-IDENTICAL to the plain step
+        loop (argmax over the same logits) and sampled decode draws
+        the SAME stream the non-speculative path is the oracle for —
+        for the deterministic drafters this is exactly the
+        Leviathan accept/residual rule (accept draft x with
+        probability p(x); on rejection the emitted token is p
+        conditioned on != x).  Junk columns past a row's true draft
+        count (``dlens``) scatter to the TRASH block — tables cover
+        exactly the verify span under lazy allocation, so letting a
+        clipped junk write land beside (or scatter-collide with) a
+        real slot would corrupt the cache."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        n_heads, P, V = self._paged_lm_static()
+        bs = int(block_size)
+        S_keys = T * bs
+        Sq = int(K) + 1
+
+        def logits_of(params, x_last):
+            return _head_logits(x_last, params["head_w"],
+                                params["head_b"])
+
+        sample_rows = _sample_rows
+        att = self._decode_attend()
+
+        def run(params, pks, pvs, tables, pos, toks, dlens, gen_idx,
+                temps, seeds):
+            keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
+            offs = jnp.arange(Sq)
+            posn = jnp.clip(pos[:, None] + offs[None, :], 0, P - 1)
+            x = params["emb_w"][jnp.clip(toks, 0, V - 1)] + \
+                jnp.take(params["emb_pos"], posn, axis=0)
+            wpos = jnp.clip(pos[:, None] + offs[None, :], 0,
+                            S_keys - 1)
+            wblock = jnp.take_along_axis(tables, wpos // bs, axis=1)
+            # Column 0 is the row's current token, columns 1..dlen
+            # its drafts; pad columns write to trash (see
+            # _build_paged_extend — a clipped junk write colliding
+            # with a real one is scatter-order-undefined).
+            wblock = jnp.where(offs[None, :] <= dlens[:, None],
+                               wblock, KVBlockPool.TRASH)
+            wslot = wpos % bs
+            qpos = pos[:, None] + offs[None, :]
+            key_mask = (jnp.arange(S_keys)[None, None, :] <=
+                        qpos[:, :, None])
+            new_pks, new_pvs = [], []
+            for pk, pv, p, H in zip(pks, pvs, params["blocks"],
+                                    n_heads):
+                x, pk, pv = self._paged_block(
+                    p, x, pk, pv, tables, wblock, wslot, key_mask, H,
+                    attend=att)
+                new_pks.append(pk)
+                new_pvs.append(pv)
+            logits = logits_of(params, x)  # (B, Sq, V)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+            def drawn(_):
+                # Per-column PRNG streams, exactly the plain step's
+                # folds — only materialized when some row actually
+                # samples (Sq categorical draws are a measurable
+                # slice of the verify budget under greedy traffic,
+                # and greedy IS argmax: _sample_rows would discard
+                # the draw anyway).
+                outs = []
+                for j in range(Sq):
+                    keys_j = jax.vmap(jax.random.fold_in)(
+                        keys0, gen_idx + j)
+                    outs.append(sample_rows(logits[:, j], keys_j,
+                                            temps))
+                return jnp.stack(outs, axis=1)
+
+            out = lax.cond(jnp.any(temps > 0.0), drawn,
+                           lambda _: greedy, None)
+            return new_pks, new_pvs, out
+
+        return jax.jit(run, donate_argnums=(1, 2))
+
+    def paged_verify(self, pool, tables, pos, toks, draft_lens,
+                     gen_idx, temps, seeds):
+        """Speculative verify entry point for the serving engine:
+        ``toks`` (B, K+1) holds each row's current token followed by
+        up to K draft tokens (``draft_lens`` true counts); returns
+        the (B, K+1) TARGET tokens (column j sampled with PRNG fold
+        ``gen_idx + j``).  The caller accepts the longest prefix
+        where draft j+1 equals output j and feeds the output at the
+        first mismatch as the bonus token.  Compiles once per
+        (B, K, T, n_blocks, block_size) — pool geometry and the
+        decode-kernel mode ride the key like every paged program."""
+        import jax
+        tables = numpy.ascontiguousarray(tables, dtype=numpy.int32)
+        toks = numpy.ascontiguousarray(toks, dtype=numpy.int32)
+        B, T = tables.shape
+        Sq = toks.shape[1]
+        fn = self.compile_cache.get_or_build(
+            ("pver", B, Sq, T, pool.n_blocks, pool.block_size,
+             self._decode_kernel_mode()),
+            lambda: self._build_paged_verify(Sq - 1, T,
+                                             pool.block_size))
+        ks, vs = pool.storage
+        # Explicit upload — see paged_extend (strict_step contract).
+        args = jax.device_put((
+            tables,
+            numpy.ascontiguousarray(pos, dtype=numpy.int32),
+            toks,
+            numpy.ascontiguousarray(draft_lens, dtype=numpy.int32),
+            numpy.ascontiguousarray(gen_idx, dtype=numpy.int32),
+            numpy.ascontiguousarray(temps, dtype=numpy.float32),
+            numpy.ascontiguousarray(seeds, dtype=numpy.uint32)))
+        ks, vs, out = fn(self._lm_params(), ks, vs, *args)
+        pool.storage = (ks, vs)
+        return numpy.asarray(out)
 
     def paged_extend(self, pool, tables, tokens, prior, chunk_lens,
                      temps, seeds):

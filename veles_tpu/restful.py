@@ -87,6 +87,24 @@ def init_parser(parser):
         help="serving: disable paged decode-step batching and fall "
              "back to whole-request generate batching")
     parser.add_argument(
+        "--serve-spec", action="store_true",
+        help="serving: enable speculative decoding with the "
+             "prompt-lookup (n-gram) drafter — greedy output stays "
+             "bit-identical to plain paged decode")
+    parser.add_argument(
+        "--serve-spec-draft", default=None, metavar="PATH",
+        help="serving: speculative draft model artifact (same "
+             "vocabulary, geometry-checked); implies --serve-spec")
+    parser.add_argument(
+        "--serve-spec-max-k", type=int, default=None, metavar="K",
+        help="serving: max draft tokens verified per dispatch "
+             "(1..15, default 4)")
+    parser.add_argument(
+        "--serve-spec-draft-blocks", type=int, default=None,
+        metavar="N",
+        help="serving: draft-model KV pool size in blocks "
+             "(default: the target pool's size)")
+    parser.add_argument(
         "--serve-drain-timeout", type=float, default=None,
         metavar="SEC",
         help="serving: graceful-stop budget — on SIGTERM/stop "
@@ -113,7 +131,8 @@ def serving_config_defaults():
     for key in ("max_batch", "queue_depth", "rate_limit", "deadline",
                 "token", "warmup", "kv_blocks", "kv_block_size",
                 "paged", "drain_timeout", "reload_watch",
-                "reload_poll"):
+                "reload_poll", "spec", "spec_draft", "spec_max_k",
+                "spec_draft_blocks"):
         value = root.common.serving.get(key)
         if value is not None:
             out[key] = value
@@ -152,7 +171,8 @@ class ModelServer(JsonHttpServer):
                  deadline=30.0, warmup=False, policy=None,
                  paged=None, kv_blocks=None, kv_block_size=16,
                  drain_timeout=30.0, reload_watch=None,
-                 reload_poll=5.0):
+                 reload_poll=5.0, spec=False, spec_draft=None,
+                 spec_max_k=4, spec_draft_blocks=None):
         if isinstance(model, str):
             model = ExportedModel(model)
         self.token = token
@@ -162,6 +182,8 @@ class ModelServer(JsonHttpServer):
             model, max_batch=max_batch, queue_depth=queue_depth,
             policy=policy, default_deadline=deadline, paged=paged,
             kv_blocks=kv_blocks, kv_block_size=kv_block_size,
+            spec=spec, spec_draft=spec_draft, spec_max_k=spec_max_k,
+            spec_draft_blocks=spec_draft_blocks,
             drain_timeout=drain_timeout)
         self.limiter = RateLimiter(rate_limit) if rate_limit else None
         self.reload_watch = reload_watch
@@ -354,7 +376,13 @@ class ModelServer(JsonHttpServer):
                     return
                 path = payload.get("artifact")
                 try:
-                    version = outer.reload_artifact(path)
+                    if payload.get("draft"):
+                        # {"draft": true}: hot-swap the speculative
+                        # DRAFT model instead of the target (same
+                        # verified-read chain).
+                        version = outer.reload_draft_artifact(path)
+                    else:
+                        version = outer.reload_artifact(path)
                 except ArtifactRejected as e:
                     self.reply(409, {"error": str(e)})
                     return
@@ -409,6 +437,24 @@ class ModelServer(JsonHttpServer):
         version = self.engine.reload(blob)
         self.engine.stats.incr("reload.artifacts")
         self.info("hot-reloaded %s -> weight version %d", path,
+                  version)
+        return version
+
+    def reload_draft_artifact(self, path):
+        """Verify-and-reload for the speculative DRAFT model: the
+        artifact is read once through the same sha256-sidecar gate
+        as a target reload, geometry/vocabulary-checked against the
+        served model, and hot-swapped into the drafter — live target
+        streams never notice (drafts are proposals, not truth)."""
+        from .serving.reload import read_verified
+        if path is None:
+            raise ArtifactRejected(
+                "a draft reload needs an explicit artifact path")
+        blob = read_verified(path, injector=self.engine.injector,
+                             require_manifest=False)
+        version = self.engine.reload_draft(blob)
+        self.engine.stats.incr("spec.draft_artifacts")
+        self.info("hot-reloaded draft %s -> draft version %d", path,
                   version)
         return version
 
@@ -503,7 +549,9 @@ class RESTfulAPI(Unit):
     ``--serve-queue-depth`` / ``--serve-rate-limit`` /
     ``--serve-deadline`` / ``--serve-token`` / ``--serve-warmup`` /
     ``--serve-kv-blocks`` / ``--serve-kv-block-size`` /
-    ``--serve-no-paged`` / ``--serve-drain-timeout`` /
+    ``--serve-no-paged`` / ``--serve-spec`` /
+    ``--serve-spec-draft`` / ``--serve-spec-max-k`` /
+    ``--serve-spec-draft-blocks`` / ``--serve-drain-timeout`` /
     ``--serve-reload-watch`` / ``--serve-reload-poll`` CLI flags or
     the matching kwargs below."""
 
@@ -528,6 +576,11 @@ class RESTfulAPI(Unit):
         self.drain_timeout = kwargs.get("drain_timeout", 30.0)
         self.reload_watch = kwargs.get("reload_watch", None)
         self.reload_poll = kwargs.get("reload_poll", 5.0)
+        self.spec = kwargs.get("spec", False)
+        self.spec_draft = kwargs.get("spec_draft", None)
+        self.spec_max_k = kwargs.get("spec_max_k", 4)
+        self.spec_draft_blocks = kwargs.get("spec_draft_blocks",
+                                            None)
         self.server = None
 
     def run(self):
@@ -541,6 +594,9 @@ class RESTfulAPI(Unit):
             deadline=self.deadline, warmup=self.warmup,
             paged=self.paged, kv_blocks=self.kv_blocks,
             kv_block_size=self.kv_block_size,
+            spec=self.spec, spec_draft=self.spec_draft,
+            spec_max_k=self.spec_max_k,
+            spec_draft_blocks=self.spec_draft_blocks,
             drain_timeout=self.drain_timeout,
             reload_watch=self.reload_watch,
             reload_poll=self.reload_poll)

@@ -4,6 +4,8 @@ under velescli with the RESTfulAPI unit, restful_api.py:78), through
 the production serving engine: shape-bucketed dynamic batching,
 paged KV-cache decode-step continuous batching for LM artifacts
 (``--kv-blocks`` / ``--kv-block-size`` / ``--no-paged-decode``),
+speculative decoding (``--spec`` n-gram drafting, ``--spec-draft``
+draft model, ``--spec-max-k`` verify width),
 ``--warmup`` grid precompilation, per-client rate limiting,
 queue-depth backpressure, hot weight reload (``--reload-watch`` /
 authenticated ``POST /admin/reload``) and graceful SIGTERM drain
@@ -62,6 +64,25 @@ def main(argv=None):
         help="disable paged decode-step continuous batching and "
              "fall back to whole-request generate batching")
     parser.add_argument(
+        "--spec", action="store_true",
+        help="enable speculative decoding on the paged decode loop "
+             "with the zero-cost prompt-lookup (n-gram) drafter — "
+             "greedy output stays bit-identical to plain decode")
+    parser.add_argument(
+        "--spec-draft", default=None, metavar="PATH",
+        help="speculative draft model: a second exported artifact "
+             "(same vocabulary, geometry-checked) proposing tokens "
+             "through its own paged pool; implies --spec")
+    parser.add_argument(
+        "--spec-max-k", type=int, default=4, metavar="K",
+        help="max draft tokens verified per dispatch (1..15; "
+             "per-row adaptive K backs off to plain decode on "
+             "streams whose drafts keep missing; default 4)")
+    parser.add_argument(
+        "--spec-draft-blocks", type=int, default=None, metavar="N",
+        help="draft-model KV pool size in blocks (default: the "
+             "target pool's size)")
+    parser.add_argument(
         "--drain-timeout", type=float, default=30.0, metavar="SEC",
         help="graceful-stop budget: on SIGTERM admissions close "
              "with 503 + Retry-After and live decode rows get this "
@@ -84,6 +105,9 @@ def main(argv=None):
         deadline=args.deadline, warmup=args.warmup,
         paged=False if args.no_paged_decode else None,
         kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
+        spec=args.spec, spec_draft=args.spec_draft,
+        spec_max_k=args.spec_max_k,
+        spec_draft_blocks=args.spec_draft_blocks,
         drain_timeout=args.drain_timeout,
         reload_watch=args.reload_watch,
         reload_poll=args.reload_poll)

@@ -26,8 +26,15 @@ between the HTTP handlers (:mod:`veles_tpu.restful`) and the device:
   moment their budget is met, and common prompt prefixes are
   prefilled once and refcount-shared.
 
-Future inference PRs (multi-host serving, speculative decoding)
-build on this layer; see docs/serving.md.
+* :mod:`~veles_tpu.serving.speculation` — speculative decoding on
+  the paged loop: prompt-lookup (n-gram) and draft-model drafters,
+  the distribution-preserving acceptance rule (greedy AND sampled
+  output bit-identical to plain decode), and per-row adaptive draft
+  budgets; one ``paged_verify`` dispatch scores K draft tokens plus
+  a bonus position.
+
+Future inference PRs (multi-host serving) build on this layer; see
+docs/serving.md.
 """
 
 from .admission import (AdmissionError, DeadlineExceeded,  # noqa: F401
@@ -39,3 +46,5 @@ from .engine import ServingEngine  # noqa: F401
 from .metrics import ServingStats  # noqa: F401
 from .reload import (ArtifactRejected, ArtifactWatcher,  # noqa: F401
                      read_verified, resolve_artifact)
+from .speculation import (MAX_SPEC_K, NGramDrafter,  # noqa: F401
+                          accept_lengths, check_draft_compat)

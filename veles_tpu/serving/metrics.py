@@ -124,6 +124,12 @@ class ServingStats(object):
             "serving.latency_seconds",
             labels={"kind": key}).observe(seconds)
 
+    def gauge(self, name):
+        """The latest value of a named gauge, or None — the engine's
+        EWMA speculative gauges read back through this."""
+        with self._lock:
+            return self._gauges.get(name)
+
     def set_gauge(self, name, value):
         """Point-in-time value (KV blocks used, active decode rows);
         the latest write wins and rides ``snapshot()`` — and the
@@ -221,6 +227,20 @@ def live_serving_summary():
                 if getattr(e, "weight_version", None)]
     if versions:
         out["weight_version"] = max(versions)
+    spec_rates = [e.stats.gauge("spec.accept_rate") for e in engines
+                  if getattr(e, "spec_mode", "off") != "off"]
+    spec_rates = [r for r in spec_rates if r is not None]
+    if spec_rates:
+        # The worst accept rate leads: a fleet member whose drafts
+        # stopped landing is the one the operator wants to see.
+        out["spec_accept_rate"] = min(spec_rates)
+        tps = [e.stats.gauge("spec.tokens_per_step")
+               for e in engines
+               if getattr(e, "spec_mode", "off") != "off"]
+        tps = [t for t in tps if t is not None]
+        if tps:
+            out["spec_tokens_per_step"] = round(
+                sum(tps) / len(tps), 3)
     breakers = {getattr(e, "_breaker", "closed") for e in engines}
     if breakers - {"closed"}:
         # Degraded state leads the row: a rebuilding/tripped breaker
