@@ -87,7 +87,9 @@ BENCH_FLAGS = ("--mlp", "--lm", "--lm-toy", "--serve", "--streamed",
                "--streamed-jpeg", "--attn-stages", "--attn-ladder",
                "--serve-streams", "--serve-seconds", "--spec",
                "--trace-out", "--optimizer", "--pp-schedule",
-               "--moe-topk", "--moe-experts")
+               "--moe-topk", "--moe-experts", "--population",
+               "--population-members", "--population-epochs",
+               "--population-ticks")
 
 # Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
 # or blocks gain <3% more.  The perf levers that got here: banded-
@@ -1220,7 +1222,7 @@ def measure_update_ms(wf, repeats=10):
     c = wf.compiler
     if not c._compiled:
         c.compile()
-    _run_forward, apply_updates, _block = c._core_
+    apply_updates = c._core_[1]
     params = {n: v.devmem for n, v in c._param_vecs.items()}
     states = {n: v.devmem for n, v in c._state_vecs.items()}
     grads = {n: jnp.zeros_like(v) for n, v in params.items()}
@@ -1260,6 +1262,116 @@ def optimizer_fields(wf, name):
     }
 
 
+def parse_population(argv):
+    """``--population[=N]`` / ``--population-members=N`` /
+    ``--population-epochs=E`` / ``--population-ticks=K`` knobs for
+    the population bench (defaults 4 members, 3 epochs, 8-tick
+    jobs).  The member count follows the product CLI's
+    ``--population N`` / ``--population=N`` spellings too."""
+    members, epochs, ticks = 4, 3, 8
+    for i, arg in enumerate(argv):
+        if arg == "--population":
+            if i + 1 < len(argv) and argv[i + 1].isdigit():
+                members = int(argv[i + 1])
+        elif arg.startswith("--population="):
+            members = int(arg.split("=", 1)[1])
+        elif arg.startswith("--population-members="):
+            members = int(arg.split("=", 1)[1])
+        elif arg.startswith("--population-epochs="):
+            epochs = int(arg.split("=", 1)[1])
+        elif arg.startswith("--population-ticks="):
+            ticks = int(arg.split("=", 1)[1])
+    return members, epochs, ticks
+
+
+def population_bench(argv):
+    """``--population``: PBT population over the in-process loopback
+    fleet contract (docs/population.md) — N member lineages with a
+    tuned learning rate trained to completion through the REAL
+    member-job/delta-fold cycle, every job serialized through the
+    tensor-frame encoder so the JSON line carries true wire costs.
+    Reports members·ticks/s (the population engine's figure of
+    merit: lineage minibatches trained per second across the whole
+    population), exploit latency, and the exploit-as-delta wire
+    ratio (exploit job bytes vs a full weight ship)."""
+    import numpy
+    import veles_tpu.prng as prng
+    from veles_tpu.config import Tune, root
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.network_common import encode_message
+    from veles_tpu.population import (PopulationMaster,
+                                      PopulationWorker)
+    from veles_tpu.population.engine import loopback_proto
+    from veles_tpu.__main__ import import_workflow_module
+
+    members, epochs, ticks = parse_population(argv)
+    module = import_workflow_module(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "veles_tpu", "znicz", "samples", "mnist.py"))
+    root.mnist.max_epochs = epochs
+    root.mnist.learning_rate = Tune(0.1, 0.001, 0.5)
+    prng.reset()
+    master = PopulationMaster(
+        Launcher(), module, mode="pbt", size=members, seed=42,
+        pbt_interval=1, pbt_quantile=0.34)
+    worker = PopulationWorker(Launcher(), module, seed=42)
+    proto = loopback_proto(ticks)
+    master.note_slave_protocol("local", proto)
+    worker.note_net_proto(proto)
+
+    sizes = {"first": [], "exploit": [], "steady": []}
+    exploit_ms = []
+    seen = set()
+    prev_exploits = 0
+    t0 = time.time()
+    while not master.should_stop_serving():
+        job = master.generate_data_for_slave("local")
+        if job is None:
+            break
+        _flags, parts = encode_message(
+            {"cmd": "job", "data": job}, codec=None, tensor=True)
+        tag = ("exploit" if "exploit" in job else
+               "first" if job["m"] not in seen else "steady")
+        seen.add(job["m"])
+        sizes[tag].append(sum(len(p) for p in parts))
+        replies = []
+        worker.do_job(job, None, replies.append)
+        master.apply_data_from_slave(replies[0], "local")
+        if master.exploits > prev_exploits:
+            prev_exploits = master.exploits
+            exploit_ms.append(master.last_exploit_ms)
+    wall = time.time() - t0
+
+    summary = master.population_summary()
+    total_ticks = sum(m.ticks_done for m in master.members)
+    full = max(sizes["first"]) if sizes["first"] else None
+    exploit_bytes = (round(float(numpy.mean(sizes["exploit"])))
+                     if sizes["exploit"] else None)
+    print(json.dumps({
+        "metric": "population_members_ticks_per_sec",
+        "value": round(total_ticks / wall, 1),
+        "unit": "members*ticks/sec",
+        "members": members,
+        "scheduling": "pbt",
+        "epochs": epochs,
+        "job_ticks": ticks,
+        "jobs": summary["jobs"],
+        "ticks": total_ticks,
+        "wall_s": round(wall, 2),
+        "exploits": master.exploits,
+        "exploit_ms_mean": (round(float(numpy.mean(exploit_ms)), 2)
+                            if exploit_ms else None),
+        "exploit_job_bytes": exploit_bytes,
+        "full_ship_bytes": full,
+        "steady_job_bytes": (round(float(numpy.median(
+            sizes["steady"]))) if sizes["steady"] else None),
+        "exploit_delta_ratio": (round(full / exploit_bytes, 1)
+                                if full and exploit_bytes else None),
+        "best_fitness": summary.get("best_fitness"),
+        "mean_fitness": summary.get("mean_fitness"),
+    }))
+
+
 def attribution_fields():
     """Live device-time/MFU gauge readings for the bench JSON line
     (the BENCH_r06 per-stage attribution record)."""
@@ -1282,6 +1394,9 @@ def main():
         # The pipeline schedule A/B micro-bench is its own mode
         # (the LM headline bench is dense/non-pipelined).
         pipeline_bench(sys.argv)
+        return
+    if any(a.startswith("--population") for a in sys.argv):
+        population_bench(sys.argv)
         return
     if "--serve" in sys.argv:
         serve_bench(sys.argv)

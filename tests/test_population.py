@@ -1,0 +1,587 @@
+"""Population engine tests (docs/population.md): GA / PBT / ensemble
+members as first-class fleet lineages on the delta data plane.
+
+The two acceptance gates ride this file tier-1: the seeded parity
+gate (a 2-member population trained over a real master+worker fleet
+is BIT-identical per member to standalone runs with the same seeds)
+and the exploit-as-delta loopback micro-bench (a PBT exploit ships
+orders of magnitude fewer wire bytes than a full weight ship).
+"""
+
+import json
+import os
+import threading
+
+import numpy
+import pytest
+
+import veles_tpu.prng as prng
+import veles_tpu.resilience as resilience
+from veles_tpu.config import Tune, override_scope, root
+from veles_tpu.error import Bug
+from veles_tpu.launcher import Launcher
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MNIST = os.path.join(REPO, "veles_tpu", "znicz", "samples", "mnist.py")
+
+SEED = 42
+STRIDE = 1000003
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+    root.mnist.reset()
+    root.ga_test.reset()
+    prev_zero = root.common.net.get("zero", 0)
+    prev_vmap = root.common.population.get("vmap", None)
+    prev_snapdir = root.common.dirs.get("snapshots", None)
+    yield
+    root.mnist.reset()
+    root.ga_test.reset()
+    root.common.net.zero = prev_zero
+    if prev_snapdir is not None:
+        root.common.dirs.snapshots = prev_snapdir
+    if prev_vmap is None:
+        root.common.population.reset()
+    else:
+        root.common.population.vmap = prev_vmap
+    root.common.loader.train_ratio = 1.0
+
+
+def _module():
+    from veles_tpu.__main__ import import_workflow_module
+    return import_workflow_module(MNIST)
+
+
+def _final_state(wf):
+    """Every trainable AND optimizer slot, mapped to host — the
+    bit-identity gates compare full lineage state, not just weights."""
+    out = {}
+    for unit in wf.units:
+        for which in ("trainables", "tstate"):
+            vecs = getattr(unit, which, None)
+            if not isinstance(vecs, dict):
+                continue
+            for attr, vec in vecs.items():
+                if vec:
+                    vec.map_read()
+                    out["%s/%s/%s" % (unit.name, which, attr)] = \
+                        numpy.array(vec.mem)
+    return out
+
+
+def _assert_states_equal(a, b, label):
+    assert set(a) == set(b) and a, label
+    for key in a:
+        assert a[key].dtype == b[key].dtype, (label, key)
+        assert numpy.array_equal(a[key], b[key]), \
+            "%s: %s diverged" % (label, key)
+
+
+def _drive_loopback(master, workers, proto, max_cycles=5000):
+    """Deterministic round-robin driver over the in-process loopback
+    (the same member-job contract the socket fleet runs)."""
+    for sid, wf in workers.items():
+        master.note_slave_protocol(sid, proto)
+        wf.note_net_proto(proto)
+    for _ in range(max_cycles):
+        if master.should_stop_serving():
+            return
+        jobs = {}
+        for sid in workers:
+            job = master.generate_data_for_slave(sid)
+            if job is not None:
+                jobs[sid] = job
+        if not jobs:
+            if master.should_stop_serving():
+                return
+            raise AssertionError("population stalled mid-run")
+        for sid, job in jobs.items():
+            replies = []
+            workers[sid].do_job(job, None, replies.append)
+            master.apply_data_from_slave(replies[0], sid)
+    raise AssertionError("driver did not converge in %d cycles"
+                         % max_cycles)
+
+
+# -- config / prng isolation primitives ---------------------------------
+
+
+def test_override_scope_restores_exact_leaves():
+    tune = Tune(0.1, 0.001, 0.5)
+    root.ga_test.lr = tune
+    root.ga_test.depth = 3
+    with override_scope(root, {"ga_test.lr": 0.3,
+                               "ga_test.fresh.leaf": 7}):
+        assert root.ga_test.lr == 0.3
+        assert root.ga_test.fresh.leaf == 7
+        assert root.ga_test.depth == 3
+    # Previously-set leaves come back BY OBJECT (the Tune survives);
+    # vivified leaves are deleted again.
+    assert root.ga_test.lr is tune
+    assert "leaf" not in root.ga_test.fresh.__dict__
+
+
+def test_override_scope_restores_on_error():
+    root.ga_test.lr = 0.1
+    with pytest.raises(RuntimeError):
+        with override_scope(root, {"ga_test.lr": 9.0}):
+            raise RuntimeError("boom")
+    assert root.ga_test.lr == 0.1
+
+
+def test_prng_scoped_isolation():
+    """Draws inside a scope never advance the outer streams — the
+    mechanism that keeps member A's shuffles out of member B's
+    trajectory."""
+    prng.reset()
+    prng.get(0).seed(7)
+    expected = [numpy.asarray(prng.get(0).jax_key())
+                for _ in range(2)]
+
+    prng.reset()
+    prng.get(0).seed(7)
+    first = numpy.asarray(prng.get(0).jax_key())
+    store = {}
+    with prng.scoped(store):
+        prng.get(0).seed(99)
+        for _ in range(5):
+            prng.get(0).jax_key()
+    second = numpy.asarray(prng.get(0).jax_key())
+    assert numpy.array_equal(first, expected[0])
+    assert numpy.array_equal(second, expected[1])
+    assert store  # the scope's draws landed in its own registry
+
+
+def test_evaluate_chromosome_does_not_leak_genes():
+    """Regression (the satellite fix): two conflicting chromosomes
+    evaluated in-process must not leak gene overrides — the old
+    destructive ``apply_genes`` left the first chromosome's value in
+    the global tree."""
+    from veles_tpu.genetics.core import collect_tunes
+    from veles_tpu.genetics.optimizer import evaluate_chromosome
+    root.mnist.max_epochs = 2
+    tune = Tune(0.1, 0.0001, 0.5)
+    root.mnist.learning_rate = tune
+    tunes = [(p, t) for p, t in collect_tunes(root)
+             if p == "mnist.learning_rate"]
+    assert len(tunes) == 1
+    module = _module()
+
+    prng.reset()
+    fit_hi = evaluate_chromosome(module, tunes, [0.1], seed=SEED)
+    # The Tune leaf is back BY OBJECT — no stale 0.3 in the tree.
+    assert root.mnist.learning_rate is tune
+    prng.reset()
+    fit_lo = evaluate_chromosome(module, tunes, [0.0002], seed=SEED)
+    assert root.mnist.learning_rate is tune
+    # The conflicting gene really took effect per evaluation: a sane
+    # lr beats the degenerate one (with the leak, run 2 would reuse
+    # run 1's lr and the fitnesses would read identical).
+    assert fit_hi != fit_lo
+    assert fit_hi > fit_lo
+
+
+# -- THE parity gate: fleet == standalone, bit for bit ------------------
+
+
+def test_population_fleet_parity_gate():
+    """A 2-member population trained over a REAL master+1-worker
+    socket fleet produces bit-identical per-member weights AND
+    optimizer slots vs the same module trained standalone with the
+    member seeds (the PR-4 equivalence-gate pattern)."""
+    from veles_tpu.client import Client
+    from veles_tpu.harness import run_workflow_module
+    from veles_tpu.population import PopulationMaster, PopulationWorker
+    from veles_tpu.server import Server
+    module = _module()
+    root.mnist.max_epochs = 2
+    root.common.net.zero = 1  # slots ride the per-member delta plane
+
+    master = PopulationMaster(Launcher(), module, mode="train",
+                              size=2, seed=SEED)
+    server = Server(":0", master)
+    worker = PopulationWorker(Launcher(), module, seed=SEED)
+    client = Client("localhost:%d" % server.port, worker)
+    t = threading.Thread(target=client.run, daemon=True)
+    t.start()
+    server.wait(timeout=240)
+    assert not server.is_running, "population fleet failed to finish"
+    t.join(timeout=15)
+
+    fleet = {m.member_id: _final_state(m.wf)
+             for m in master.members}
+    for i, mid in enumerate(("m0", "m1")):
+        wf = run_workflow_module(module, seed=SEED + i * STRIDE)
+        _assert_states_equal(_final_state(wf), fleet[mid],
+                             "member %s fleet-vs-standalone" % mid)
+        alone_fit = float(wf.gather_results()["EvaluationFitness"])
+        assert master._members[mid].fitness == pytest.approx(
+            alone_fit, abs=0.0)
+    # Distinct seeds produced genuinely different members.
+    assert not numpy.array_equal(
+        next(iter(fleet["m0"].values())),
+        next(iter(fleet["m1"].values())))
+
+
+def test_worker_drop_mid_generation_requeues_and_parity():
+    """Chaos coverage: a worker dropped mid-generation (the
+    ``worker.job`` churn class) requeues the member's in-flight ticks
+    with their original step keys, a straggler reply from the dead
+    worker is dropped as stale, and the final fitness table and
+    lineage states are UNCHANGED vs an un-dropped run."""
+    from veles_tpu.population import PopulationMaster, PopulationWorker
+    from veles_tpu.population.engine import loopback_proto
+    module = _module()
+    root.mnist.max_epochs = 2
+    proto = loopback_proto()
+
+    def build():
+        return PopulationMaster(Launcher(), module, mode="train",
+                                size=2, seed=SEED)
+
+    # Clean single-worker reference run.
+    clean = build()
+    w_ref = PopulationWorker(Launcher(), module, seed=SEED)
+    _drive_loopback(clean, {"w2": w_ref}, proto)
+    ref_fits = {m.member_id: m.fitness for m in clean.members}
+    ref_state = {m.member_id: _final_state(m.wf)
+                 for m in clean.members}
+
+    # Chaos run: w1 takes the first job, dies before replying.
+    master = build()
+    w1 = PopulationWorker(Launcher(), module, seed=SEED)
+    w2 = PopulationWorker(Launcher(), module, seed=SEED)
+    master.note_slave_protocol("w1", proto)
+    w1.note_net_proto(proto)
+    job = master.generate_data_for_slave("w1")
+    assert job is not None
+    straggler = []
+    w1.do_job(job, None, straggler.append)
+    before = resilience.stats.snapshot().get(
+        "population.requeues", 0)
+    master.drop_slave("w1")
+    assert master.requeues == 1
+    assert resilience.stats.snapshot().get(
+        "population.requeues", 0) == before + 1
+    member = master._members[job["m"]]
+    assert member.requeued_keys, \
+        "dropped job's step key was not requeued"
+    # The dead worker's reply lands late: it must drop as stale, not
+    # fold (the batch re-trains on the survivor).
+    master.apply_data_from_slave(straggler[0], "w1")
+    assert resilience.stats.snapshot().get(
+        "population.stale_updates", 0) == 1
+    _drive_loopback(master, {"w2": w2}, proto)
+    assert {m.member_id: m.fitness
+            for m in master.members} == ref_fits
+    for m in master.members:
+        _assert_states_equal(ref_state[m.member_id],
+                             _final_state(m.wf),
+                             "member %s chaos-vs-clean" % m.member_id)
+
+
+# -- PBT loopback: exploit-as-delta + observability surfaces ------------
+
+
+@pytest.fixture(scope="module")
+def pbt_run():
+    """One shared PBT loopback run (3 members, tuned lr, 2 exploits
+    at this seed) measuring every job's REAL wire size through the
+    tensor-frame encoder."""
+    from veles_tpu.network_common import encode_message
+    from veles_tpu.population import PopulationMaster, PopulationWorker
+    from veles_tpu.population.engine import loopback_proto
+    root.mnist.reset()
+    root.mnist.max_epochs = 3
+    root.mnist.learning_rate = Tune(0.1, 0.001, 0.5)
+    try:
+        module = _module()
+        master = PopulationMaster(
+            Launcher(), module, mode="pbt", size=3, seed=SEED,
+            pbt_interval=1, pbt_quantile=0.34)
+        worker = PopulationWorker(Launcher(), module, seed=SEED)
+        proto = loopback_proto()
+        master.note_slave_protocol("local", proto)
+        worker.note_net_proto(proto)
+        sizes = []  # (tag, member, bytes)
+        seen = set()
+        while not master.should_stop_serving():
+            job = master.generate_data_for_slave("local")
+            if job is None:
+                break
+            flags, parts = encode_message(
+                {"cmd": "job", "data": job}, codec=None, tensor=True)
+            tag = ("exploit" if "exploit" in job else
+                   "first" if job["m"] not in seen else "steady")
+            seen.add(job["m"])
+            sizes.append((tag, job["m"], sum(len(p) for p in parts)))
+            replies = []
+            worker.do_job(job, None, replies.append)
+            master.apply_data_from_slave(replies[0], "local")
+        stats = resilience.stats.snapshot()
+        yield {"master": master, "worker": worker, "sizes": sizes,
+               "stats": stats}
+    finally:
+        root.mnist.reset()
+
+
+def test_pbt_exploit_ships_delta_micro_bench(pbt_run):
+    """The loopback micro-bench gate: an exploit-carrying job (the
+    lagging member lands on the leader's weights) costs a tiny
+    fraction of a full weight ship — the member's synced base was
+    re-pointed at the leader's, so the wire carries a collapsing xor
+    delta, not the model."""
+    master, sizes = pbt_run["master"], pbt_run["sizes"]
+    assert master.exploits >= 1
+    full = max(n for tag, _m, n in sizes if tag == "first")
+    exploit_jobs = [(m, n) for tag, m, n in sizes if tag == "exploit"]
+    assert len(exploit_jobs) == master.exploits
+    for mid, n in exploit_jobs:
+        ratio = full / float(n)
+        print("\nexploit job for %s: %d B vs %d B full ship "
+              "-> %.0fx smaller" % (mid, n, full, ratio))
+        assert n * 50 < full, (
+            "exploit for %s shipped %d B vs %d B full — not a "
+            "delta" % (mid, n, full))
+    assert pbt_run["stats"].get("population.exploit_adopt", 0) >= 1
+    assert master.last_exploit_ms is not None
+    # Exploits bumped the adopters' lineage generations.
+    assert sum(m.generation for m in master.members) >= 1
+
+
+def test_pbt_perturbs_hypers_within_tune_range(pbt_run):
+    master = pbt_run["master"]
+    exploited = [m for m in master.members if m.generation > 0]
+    assert exploited
+    for m in exploited:
+        assert 0.001 <= m.hypers["learning_rate"] <= 0.5
+
+
+def test_population_summary_and_gauges(pbt_run):
+    from veles_tpu.observability import metrics
+    from veles_tpu.population import live_population_summary
+    master = pbt_run["master"]
+    summary = live_population_summary()
+    assert summary is not None
+    assert summary["members"] >= 3
+    assert summary["exploits"] >= master.exploits
+    assert "m0" in summary["fitness"]
+    assert "m0" in summary["generation"]
+    text = metrics.render_prometheus([metrics.registry])
+    assert "population_members" in text
+    assert 'population_member_fitness{member="m0"}' in text
+
+
+def test_population_stat_names_counted(pbt_run):
+    stats = pbt_run["stats"]
+    assert stats.get("population.jobs", 0) > 0
+    assert stats.get("population.ticks", 0) > 0
+    assert stats.get("population.exploits", 0) >= 1
+
+
+def test_web_status_population_row(pbt_run):
+    """The dashboard renders a population row from the heartbeat
+    section, and /metrics re-exposes its scalar counts as
+    master-labeled gauges."""
+    from veles_tpu.web_status import WebStatusServer
+    from veles_tpu.population import live_population_summary
+    srv = WebStatusServer(host="127.0.0.1", port=0,
+                          expiry=30.0).start()
+    try:
+        import urllib.request
+        payload = {"id": "pop-master", "workflow": "PopulationRun",
+                   "mode": "population", "epoch": 3, "runtime": 9.0,
+                   "metrics": {},
+                   "population": live_population_summary()}
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/update" % srv.port,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        urllib.request.urlopen(req, timeout=30).read()
+        page = urllib.request.urlopen(
+            "http://127.0.0.1:%d/" % srv.port, timeout=30).read() \
+            .decode()
+        assert "population" in page
+        assert "best_fitness" in page
+        metrics_page = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % srv.port,
+            timeout=30).read().decode()
+        assert 'population_members{master="pop-master"}' \
+            in metrics_page
+        assert 'population_exploits{master="pop-master"}' \
+            in metrics_page
+    finally:
+        srv.stop()
+
+
+# -- GA over fleet lineages / the vmap sub-population backend -----------
+
+
+def test_ga_fleet_applies_genes_per_lineage():
+    """GA chromosomes become lineages with genes applied through the
+    override scope + traced hypers — the global config tree never
+    mutates, and retired chromosomes free their workflows."""
+    from veles_tpu.population import PopulationMaster, PopulationWorker
+    from veles_tpu.population.engine import loopback_proto
+    root.mnist.max_epochs = 2
+    tune = Tune(0.0005, 0.0001, 0.5)
+    root.mnist.learning_rate = tune
+    module = _module()
+    master = PopulationMaster(Launcher(), module, mode="ga", size=3,
+                              seed=SEED, generations=2)
+    worker = PopulationWorker(Launcher(), module, seed=SEED)
+    _drive_loopback(master, {"w": worker}, loopback_proto())
+    assert root.mnist.learning_rate is tune  # no gene leak
+    assert master._ga_pop.complete
+    assert master.best is not None and master.best[0] == "ga"
+    assert "mnist.learning_rate" in master.best[2]
+    fits = [m.fitness for m in master.members]
+    assert len(fits) > 3 and len(set(fits)) >= 2
+    # Recorded chromosomes retired their workflows AND guardian
+    # snapshots (a GA run must not hold one model per evaluated
+    # chromosome)...
+    assert all(m.wf is None and m.retired and m.last_good is None
+               for m in master.members)
+    # ...and the retire markers riding later generations' jobs freed
+    # the worker-side sync contexts of earlier generations too —
+    # bounded by population size, never size×generations.
+    assert len(worker._contexts) <= 3, sorted(worker._contexts)
+
+
+def test_vmap_backend_gating():
+    from veles_tpu.population.vmap_backend import VmapSubPopulation
+    module = _module()
+    root.ga_test.reset()
+    root.mnist.learning_rate = Tune(0.01, 0.001, 0.1)
+    from veles_tpu.genetics.core import collect_tunes
+    tunes = collect_tunes(root)
+    assert VmapSubPopulation.applicable(module, tunes)
+    root.common.population.vmap = False
+    assert not VmapSubPopulation.applicable(module, tunes)
+    root.common.population.vmap = True
+    # Topology tunes cannot ride the vmapped path.
+    root.ga_test.n_layers = Tune(2, 1, 4)
+    assert not VmapSubPopulation.applicable(
+        module, collect_tunes(root))
+
+
+def test_engine_auto_mode_selection():
+    from types import SimpleNamespace
+    from veles_tpu.population import PopulationEngine
+    args = SimpleNamespace(listen_address=None, master_address=None,
+                           result_file=None, random_seed="42",
+                           pbt=False)
+    main = SimpleNamespace(module=None, args=args)
+    assert PopulationEngine(main, 2).mode == "train"
+    root.mnist.learning_rate = Tune(0.01, 0.001, 0.1)
+    assert PopulationEngine(main, 2).mode == "ga"
+    args.pbt = True
+    assert PopulationEngine(main, 2).mode == "pbt"
+
+
+def test_fleet_mode_rejects_topology_tunes():
+    from veles_tpu.population import PopulationMaster
+    root.mnist.max_epochs = 1
+    root.ga_test.n_layers = Tune(2, 1, 4)
+    with pytest.raises(Bug):
+        PopulationMaster(Launcher(), _module(), mode="ga", size=2,
+                         seed=SEED, generations=1)
+
+
+# -- CLI + ensemble satellites ------------------------------------------
+
+
+def test_population_cli_end_to_end(tmp_path):
+    from veles_tpu.__main__ import Main
+    result = tmp_path / "pop.json"
+    prng.reset()
+    rc = Main([MNIST, "root.mnist.max_epochs=1",
+               "--population", "2",
+               "--result-file", str(result),
+               "--random-seed", "42", "-v", "warning"]).run()
+    assert rc == 0
+    data = json.loads(result.read_text())
+    assert data["mode"] == "population"
+    assert data["scheduling"] == "train"
+    assert data["size"] == 2
+    assert set(data["summary"]["fitness"]) == {"m0", "m1"}
+    # Summary fitnesses are rounded to 6 digits for the dashboard.
+    assert data["best_fitness"] == pytest.approx(
+        max(data["summary"]["fitness"].values()), abs=1e-6)
+
+
+def test_ensemble_population_matches_sequential(tmp_path):
+    """``--ensemble-train`` routed through the population scheduler
+    produces the SAME per-instance seeds and bit-equal fitnesses as
+    the sequential in-process path (one override mechanism, one
+    trajectory), plus the same snapshots + description JSON."""
+    from veles_tpu.__main__ import Main
+    descs = {}
+    for name, extra in (("seq", []), ("pop", ["--ensemble-population"])):
+        result = tmp_path / ("ens_%s.json" % name)
+        prng.reset()
+        rc = Main([MNIST, "root.mnist.max_epochs=2",
+                   "--ensemble-train", "2:0.8",
+                   "--result-file", str(result),
+                   "--snapshot-dir", str(tmp_path / name),
+                   "--random-seed", "42", "-v", "warning"] +
+                  extra).run()
+        assert rc == 0
+        descs[name] = json.loads(result.read_text())
+    seq, pop = descs["seq"], descs["pop"]
+    assert [i["seed"] for i in seq["instances"]] == \
+        [i["seed"] for i in pop["instances"]]
+    for a, b in zip(seq["instances"], pop["instances"]):
+        assert a["fitness"] == b["fitness"], (
+            "instance %d: sequential %r vs population %r"
+            % (a["index"], a["fitness"], b["fitness"]))
+        assert a["train_ratio"] == b["train_ratio"] == 0.8
+        assert os.path.isfile(b["snapshot"])
+
+
+def test_vmap_backend_is_strict_step_clean():
+    """After the first generation compiles, the vmapped
+    sub-population evaluate loop runs with zero new compiles and no
+    implicit host transfers (the analysis.runtime enforcer)."""
+    from veles_tpu.analysis import runtime
+    from veles_tpu.genetics.core import collect_tunes
+    from veles_tpu.population.vmap_backend import VmapSubPopulation
+    root.mnist.max_epochs = 2
+    root.mnist.learning_rate = Tune(0.01, 0.001, 0.5)
+    tunes = collect_tunes(root)
+    prng.reset()
+    backend = VmapSubPopulation(_module(), tunes, seed=SEED)
+    genes = [[0.01], [0.1], [0.3]]
+    warm = backend.evaluate(genes)  # compiles the generation program
+    with runtime.strict_step():
+        again = backend.evaluate(genes)
+    numpy.testing.assert_array_equal(warm, again)
+    assert backend.generations_evaluated == 2
+
+
+def test_coordinator_forces_fleet_path_over_vmap():
+    """A coordinator (-l) must NEVER take the in-process vmap GA
+    shortcut: the server would silently never bind and every worker
+    dialed at it would spin on connection-refused."""
+    from types import SimpleNamespace
+    from veles_tpu.population import PopulationEngine
+    root.mnist.learning_rate = Tune(0.01, 0.001, 0.1)
+    args = SimpleNamespace(listen_address="127.0.0.1:0",
+                           master_address=None, result_file=None,
+                           random_seed="42", pbt=False)
+    main = SimpleNamespace(module=_module(), args=args)
+    engine = PopulationEngine(main, 2)
+    assert engine.mode == "ga"
+    assert engine._vmap_backend_applicable()  # the shortcut WOULD fit
+    called = []
+
+    def fake_coordinator():
+        called.append("coordinator")
+        engine.master = SimpleNamespace(best=None)
+
+    engine._run_coordinator = fake_coordinator
+    engine._run_ga_vmap = lambda: called.append("vmap")
+    engine._finish = lambda best: None
+    engine.run()
+    assert called == ["coordinator"]

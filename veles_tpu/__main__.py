@@ -401,6 +401,18 @@ class Main(Logger, CommandLineBase):
             from .observability import attribution
             attribution.configure_xprof(args.xprof,
                                         args.xprof_steps)
+        # Population engine knobs (population.init_parser;
+        # docs/population.md) — read back by PopulationMaster and
+        # the vmap sub-population backend.
+        if args.pbt_interval is not None:
+            root.common.population.pbt_interval = args.pbt_interval
+        if args.pbt_quantile is not None:
+            root.common.population.pbt_quantile = args.pbt_quantile
+        if args.pbt_perturb is not None:
+            root.common.population.pbt_perturb = args.pbt_perturb
+        if args.population_vmap is not None:
+            root.common.population.vmap = \
+                args.population_vmap == "on"
 
     def load(self, WorkflowClass, **kwargs):
         """``load`` closure passed to the module's run() hook
@@ -521,6 +533,21 @@ class Main(Logger, CommandLineBase):
         EnsembleTrainer(main=self, instances=n,
                         train_ratio=ratio).run()
 
+    def run_population(self):
+        """--population / --pbt dispatch: fleet-scheduled member
+        lineages (docs/population.md)."""
+        from .population import PopulationEngine
+        spec = self.args.population or "2"
+        generations = None
+        if ":" in spec:
+            size, generations = (int(p) for p in spec.split(":"))
+        else:
+            size = int(spec)
+        engine = PopulationEngine(
+            main=self, size=size, generations=generations,
+            mode="pbt" if self.args.pbt else None)
+        engine.run()
+
     def run_ensemble_test(self):
         from .ensemble import EnsembleTester
         EnsembleTester(main=self,
@@ -565,7 +592,9 @@ class Main(Logger, CommandLineBase):
                     self.args.workflow)))
                 prng.poison_numpy_random()
             try:
-                if self.args.optimize:
+                if self.args.population or self.args.pbt:
+                    self.run_population()
+                elif self.args.optimize:
                     self.run_genetics()
                 elif self.args.ensemble_train:
                     self.run_ensemble_train()

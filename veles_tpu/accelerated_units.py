@@ -494,7 +494,7 @@ class StepCompiler(object):
                         new_states[pname(gd, a)] = val
             return new_params, new_states
 
-        def train_step(params, states, batch, consts, key):
+        def train_core(params, states, batch, consts, key, hypers):
             def loss_fn(p):
                 loss, metrics, new_states, outputs = run_forward(
                     p, states, batch, consts, key, True)
@@ -518,8 +518,12 @@ class StepCompiler(object):
                 if device_skip:
                     gate = finite
             new_params, new_states = apply_updates(
-                params, grads, new_states, gate)
+                params, grads, new_states, gate, hypers=hypers)
             return new_params, new_states, outputs, metrics
+
+        def train_step(params, states, batch, consts, key):
+            return train_core(params, states, batch, consts, key,
+                              None)
 
         def infer_step(params, states, batch, consts, key):
             loss, metrics, new_states, outputs = run_forward(
@@ -597,12 +601,16 @@ class StepCompiler(object):
         self._train_fn = train_step
         self._infer_fn = infer_step
         self._block_fn = block_step
-        # Core closures reused by compile_population.
-        self._core_ = (run_forward, apply_updates, block_core)
+        # Core closures reused by compile_population and the
+        # hyper-traced per-member variants (population engine).
+        self._core_ = (run_forward, apply_updates, block_core,
+                       train_core)
         self._param_vecs = param_vecs
         self._state_vecs = state_vecs
         self._fingerprint = self.fingerprint()
         self._step_flops_ = {}
+        self._hyper_progs_ = {}
+        self._hyper_vals_ = {}
         self._compiled = True
 
     def invalidate(self):
@@ -611,6 +619,68 @@ class StepCompiler(object):
         trace changes without a shape change — e.g. the guardian's
         LR backoff rewriting ``gd.learning_rate`` mid-run."""
         self._compiled = None
+
+    # -- hyper-traced variants (population lineages) -----------------------
+
+    def _hyper_program(self, mode, names):
+        """A jitted step whose GD hyperparameters ride as ONE traced
+        f32 vector aligned with ``names`` — the single-member form of
+        ``compile_population``'s traced hypers.  Population jobs carry
+        per-member gene overrides (docs/population.md): baking them as
+        Python constants would recompile the worker's step on every
+        member switch; as traced inputs there is exactly one extra
+        program per (mode, hyper-name layout)."""
+        prog = self._hyper_progs_.get((mode, names))
+        if prog is not None:
+            return prog
+        import jax
+        from .analysis import runtime as _art
+        _art.note_compile("step_h:%s:%s" % (mode, ",".join(names)))
+        block_core, train_core = self._core_[2], self._core_[3]
+        if mode == "train":
+            def fn(params, states, batch, consts, key, hvals):
+                hypers = {n: hvals[i] for i, n in enumerate(names)}
+                return train_core(params, states, batch, consts, key,
+                                  hypers)
+        else:
+            def fn(params, states, blocks, consts, key, training,
+                   hvals):
+                hypers = {n: hvals[i] for i, n in enumerate(names)}
+                return block_core(params, states, blocks, consts, key,
+                                  training, hypers)
+        if config_get(root.common.engine.precision_level, 0) >= 2:
+            fn = jax.default_matmul_precision("highest")(fn)
+        prog = jax.jit(fn, donate_argnums=(0, 1))
+        self._hyper_progs_[(mode, names)] = prog
+        return prog
+
+    #: LRU bound on the cached per-value hyper vectors: every PBT
+    #: exploit mints a fresh value tuple, so an uncapped cache would
+    #: grow one device scalar vector per exploit for the process
+    #: lifetime.  Live members re-serving the same genes stay hits;
+    #: the cap only needs to exceed the concurrent member count.
+    HYPER_VALS_CAP = 64
+
+    def _hyper_values(self, hypers):
+        """(names, device vector) for a ``{name: float}`` override
+        dict, LRU-cached per distinct value tuple — the upload is
+        explicit (device_put) and members re-serving the same genes
+        reuse the same device array (strict_step-clean steady
+        state)."""
+        import jax
+        import numpy
+        names = tuple(sorted(hypers))
+        key = (names, tuple(float(hypers[n]) for n in names))
+        cached = self._hyper_vals_.pop(key, None)
+        if cached is None:
+            cached = jax.device_put(numpy.asarray(
+                [hypers[n] for n in names], numpy.float32))
+        # Re-insert at the newest end (dicts preserve insertion
+        # order); evict from the oldest end past the cap.
+        self._hyper_vals_[key] = cached
+        while len(self._hyper_vals_) > self.HYPER_VALS_CAP:
+            self._hyper_vals_.pop(next(iter(self._hyper_vals_)))
+        return names, cached
 
     # -- execution ---------------------------------------------------------
 
@@ -648,7 +718,7 @@ class StepCompiler(object):
                 return next(iter(tree.values()))
         return None
 
-    def execute(self, key=None, training=True):
+    def execute(self, key=None, training=True, hypers=None):
         from .observability import attribution
         from .observability import tracing
         if not self._compiled or self.fingerprint() != self._fingerprint:
@@ -661,14 +731,24 @@ class StepCompiler(object):
             from . import prng
             key = prng.get().jax_key()
         mode = "train" if training else "infer"
+        # Hyper overrides apply to TRAIN dispatches only (inference
+        # runs no update rule, so member genes cannot matter there).
+        hyper_args = None
+        if training and hypers:
+            names, hvals = self._hyper_values(hypers)
+            train_fn = self._hyper_program("train", names)
+            hyper_args = (hvals,)
+        else:
+            train_fn = self._train
         flops = self._maybe_flops(
-            mode, self._train if training else self._infer,
-            params, states, batch, consts, key)
+            mode, train_fn if training else self._infer,
+            params, states, batch, consts, key, *(hyper_args or ()))
         timer = attribution.begin_step(ticks=1, flops=flops)
         with tracing.span("step.dispatch", mode=mode):
             if training:
                 new_params, new_states, outputs, metrics = \
-                    self._train(params, states, batch, consts, key)
+                    train_fn(params, states, batch, consts, key,
+                             *(hyper_args or ()))
                 for n, v in self._param_vecs.items():
                     v.devmem = new_params[n]
             else:
@@ -698,7 +778,7 @@ class StepCompiler(object):
                 jax.device_put(numpy.float32(1.0)))
         return flags[1 if training else 0]
 
-    def execute_block(self, blocks, training, key=None):
+    def execute_block(self, blocks, training, key=None, hypers=None):
         """Dispatches K stacked ticks at once; ``blocks`` maps batch
         vector id → (K, ...) numpy/jax array."""
         import jax
@@ -718,13 +798,23 @@ class StepCompiler(object):
         # host-sync inside the hot loop.
         blocks = {k: jax.device_put(v) for k, v in blocks.items()}
         flag = self._training_flag(training)
-        flops = self._maybe_flops(("block", ticks), self._block,
+        # Hyper-traced block variant (population member genes): the
+        # traced training flag already gates updates, so one program
+        # serves train and validation blocks alike.
+        hyper_args = None
+        block_fn = self._block
+        if hypers:
+            names, hvals = self._hyper_values(hypers)
+            block_fn = self._hyper_program("block", names)
+            hyper_args = (hvals,)
+        flops = self._maybe_flops(("block", ticks), block_fn,
                                   params, states, blocks, consts,
-                                  key, flag)
+                                  key, flag, *(hyper_args or ()))
         timer = attribution.begin_step(ticks=ticks, flops=flops)
         with tracing.span("step.dispatch", mode="block", ticks=ticks):
-            new_params, new_states = self._block(
-                params, states, blocks, consts, key, flag)
+            new_params, new_states = block_fn(
+                params, states, blocks, consts, key, flag,
+                *(hyper_args or ()))
         for n, v in self._param_vecs.items():
             v.devmem = new_params[n]
         for n, v in self._state_vecs.items():
@@ -746,7 +836,7 @@ class StepCompiler(object):
         import jax
         if not self._compiled:
             self.compile()
-        _, _, block_core = self._core_
+        block_core = self._core_[2]
         names = tuple(hyper_names)
 
         def pop_block(pop_params, pop_states, blocks, consts, key,
@@ -872,7 +962,8 @@ class AcceleratedWorkflow(Workflow):
                      getattr(loader, "epoch_number", "?"),
                      getattr(loader, "minibatch_class", "?"))
 
-    def execute_block(self, blocks, training=None):
+    def execute_block(self, blocks, training=None, key=None,
+                      hypers=None):
         """Dispatches a stacked block of ticks (see
         StepCompiler.execute_block)."""
         if self._step_done_tick_ == self._tick_id_:
@@ -897,7 +988,9 @@ class AcceleratedWorkflow(Workflow):
         if training is None:
             training = self.training
         self.compiler.execute_block(
-            blocks, training, key=prng.get().jax_key())
+            blocks, training,
+            key=key if key is not None else prng.get().jax_key(),
+            hypers=hypers)
         self.step_metrics = {}
 
     def fetch_metrics(self):
@@ -981,20 +1074,40 @@ class AcceleratedWorkflow(Workflow):
         block = take_block() if take_block is not None else None
         self.begin_tick()
         from . import prng
+        # Population jobs (docs/population.md) pin the step RNG key:
+        # the master draws it from the MEMBER's own key chain at serve
+        # time, so a member's trajectory is bit-identical to the same
+        # seeds trained standalone no matter how members interleave on
+        # this worker.  Per-member gene overrides ride as traced
+        # hypers the same way.  Ordinary sessions carry neither field
+        # and keep drawing from the worker's local stream.
+        key = meta.get("rng")
+        if key is not None:
+            import jax
+            import numpy
+            key = jax.device_put(numpy.ascontiguousarray(key))
+        hypers = meta.get("hypers") or None
         if block is not None:
-            host_metrics = self._run_job_block(block, cls, training)
+            host_metrics = self._run_job_block(block, cls, training,
+                                               key=key, hypers=hypers)
         else:
-            metrics = self.compiler.execute(key=prng.get().jax_key(),
-                                            training=training)
+            metrics = self.compiler.execute(
+                key=key if key is not None else prng.get().jax_key(),
+                training=training, hypers=hypers)
             import jax
             host_metrics = {k: float(jax.device_get(v))
                             for k, v in metrics.items()}
         result = self.generate_data_for_master()
         result["__metrics__"] = host_metrics
-        result["__job__"] = meta
+        # The echoed meta keys the master's decision bucket; the rng
+        # key and hyper overrides were inputs, not accounting — keep
+        # them off the update wire.
+        result["__job__"] = {k: v for k, v in meta.items()
+                             if k not in ("rng", "hypers")}
         callback(result)
 
-    def _run_job_block(self, block, cls, training):
+    def _run_job_block(self, block, cls, training, key=None,
+                       hypers=None):
         """Dispatches a multi-tick job block and returns aggregate
         metrics for the master's decision bucket ("ticks" marks them
         as pre-summed over K minibatches)."""
@@ -1003,7 +1116,7 @@ class AcceleratedWorkflow(Workflow):
             ev.reset_epoch_acc(cls)
             if hasattr(ev, "reset_health_acc"):
                 ev.reset_health_acc(cls)
-        self.execute_block(block, training)
+        self.execute_block(block, training, key=key, hypers=hypers)
         metrics = {}
         if ev is not None and hasattr(ev, "read_epoch_acc"):
             row = ev.read_epoch_acc(cls)

@@ -177,6 +177,7 @@ CONTRIBUTING_MODULES = (
     "veles_tpu.ops.attention",
     "veles_tpu.ops.moe",
     "veles_tpu.ops.pipeline",
+    "veles_tpu.population",
     "veles_tpu.restful",
     "veles_tpu.snapshotter",
     "veles_tpu.znicz.optimizers",

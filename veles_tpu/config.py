@@ -12,6 +12,7 @@ JAX/XLA (precision, mesh axis names, checkpoint dirs) instead of
 OpenCL/CUDA device settings.
 """
 
+import contextlib
 import os
 import pprint
 
@@ -142,6 +143,42 @@ def get(value, default=None):
     if isinstance(value, Tune):
         return value.default
     return value
+
+
+@contextlib.contextmanager
+def override_scope(node, overrides):
+    """Applies ``{dotted.path: value}`` leaf overrides under ``node``
+    and RESTORES the exact prior leaves on exit — previously-set
+    values (``Tune`` objects included) are put back by object, and
+    leaves that did not exist are deleted again.
+
+    This is the per-run config variation mechanism shared by genetics
+    chromosome evaluation, ensemble per-instance variation, and
+    population lineages (docs/population.md): the config tree is
+    process-global, so any in-process multi-member evaluation that
+    writes gene/variation overrides without save/restore leaks them
+    into every later member.  Intermediate nodes vivified by the walk
+    are left in place (an empty Config node reads as unset).
+    """
+    saved = []  # (parent, leaf, existed, old_value) in apply order
+    try:
+        for path, value in overrides.items():
+            parts = path.split(".")
+            parent = node
+            for part in parts[:-1]:
+                parent = getattr(parent, part)
+            leaf = parts[-1]
+            existed = leaf in parent.__dict__
+            saved.append((parent, leaf, existed,
+                          parent.__dict__.get(leaf)))
+            setattr(parent, leaf, value)
+        yield
+    finally:
+        for parent, leaf, existed, old in reversed(saved):
+            if existed:
+                object.__setattr__(parent, leaf, old)
+            elif leaf in parent.__dict__:
+                object.__delattr__(parent, leaf)
 
 
 #: The global configuration root (reference: veles/config.py:151).

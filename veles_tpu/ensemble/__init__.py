@@ -24,7 +24,7 @@ import pickle
 
 import numpy
 
-from ..config import root, get as config_get
+from ..config import root, get as config_get, override_scope
 from ..error import Bug
 from ..harness import (FITNESS_KEY, run_workflow_module, seed_to_int)
 from ..json_encoders import dump_json
@@ -54,34 +54,42 @@ class EnsembleTrainer(Logger):
             root.common.dirs.snapshots, "snapshots")
         self.stem = stem
 
-    def _train_one(self, index, seed):
-        prior = root.common.loader.get("train_ratio", 1.0)
-        root.common.loader.train_ratio = self.train_ratio
-        try:
-            wf = run_workflow_module(self.module, seed=seed)
-        finally:
-            # Never leak the subset ratio into later runs.
-            root.common.loader.train_ratio = prior
+    #: Seed stride between instances (shared with the population
+    #: scheduler so fleet-trained members reproduce this path's
+    #: per-instance seeds exactly).
+    SEED_STRIDE = 1000003
+
+    def _variation_overrides(self):
+        """The per-instance config variation, expressed as the same
+        dotted-path override set population lineages use — one
+        mechanism (``config.override_scope``) for every in-process
+        multi-member run, so variation can never leak between
+        instances or into a later run."""
+        return {"common.loader.train_ratio": self.train_ratio}
+
+    def _snapshot_workflow(self, index, wf):
         os.makedirs(self.snapshot_dir, exist_ok=True)
         snapshot = os.path.join(
             self.snapshot_dir,
             "ensemble_%s_%02d.pickle.gz" % (self.stem, index))
         with gzip.open(snapshot, "wb") as fout:
             pickle.dump(wf, fout, protocol=pickle.HIGHEST_PROTOCOL)
+        return snapshot
+
+    def _describe(self, index, seed, wf):
         results = wf.gather_results()
         return {"index": index, "seed": seed,
                 "train_ratio": self.train_ratio,
-                "snapshot": snapshot, "results": results,
+                "snapshot": self._snapshot_workflow(index, wf),
+                "results": results,
                 "fitness": results.get(FITNESS_KEY)}
 
-    def run(self):
-        instances = []
-        for i in range(self.instances):
-            seed = self.base_seed + i * 1000003
-            self.info("training ensemble instance %d/%d (seed %d, "
-                      "train_ratio %.2f)", i + 1, self.instances,
-                      seed, self.train_ratio)
-            instances.append(self._train_one(i, seed))
+    def _train_one(self, index, seed):
+        with override_scope(root, self._variation_overrides()):
+            wf = run_workflow_module(self.module, seed=seed)
+        return self._describe(index, seed, wf)
+
+    def _payload(self, instances):
         fitnesses = [inst["fitness"] for inst in instances
                      if inst["fitness"] is not None]
         payload = {
@@ -96,6 +104,46 @@ class EnsembleTrainer(Logger):
         dump_json(payload, self.result_file)
         self.info("ensemble description -> %s", self.result_file)
         return payload
+
+    def run(self):
+        if getattr(self.main.args, "ensemble_population", False):
+            return self.run_on_population()
+        instances = []
+        for i in range(self.instances):
+            seed = self.base_seed + i * self.SEED_STRIDE
+            self.info("training ensemble instance %d/%d (seed %d, "
+                      "train_ratio %.2f)", i + 1, self.instances,
+                      seed, self.train_ratio)
+            instances.append(self._train_one(i, seed))
+        return self._payload(instances)
+
+    def run_on_population(self):
+        """``--ensemble-train`` over the population scheduler
+        (``--ensemble-population``, docs/population.md): instances
+        become fleet-scheduled lineages — trained concurrently by
+        whatever workers are attached when a master is running
+        (``-l``), self-driven in-process otherwise — and produce the
+        same per-instance snapshots + description JSON as the
+        sequential path (bit-identical trajectories: the seeded
+        parity gate in tests/test_population.py)."""
+        from ..population import PopulationEngine
+        engine = PopulationEngine(
+            main=self.main, size=self.instances, mode="train",
+            seed_stride=self.SEED_STRIDE,
+            base_overrides=self._variation_overrides())
+        # The engine owns scheduling; the description JSON is ours.
+        engine.result_file = None
+        engine.run()
+        master = engine.master
+        if master is None:
+            return None  # worker mode: the coordinator reports
+        instances = []
+        for i, member in enumerate(master.members):
+            if member.wf is None:
+                continue
+            instances.append(self._describe(i, member.seed,
+                                            member.wf))
+        return self._payload(instances)
 
 
 class EnsembleTester(Logger):

@@ -7,5 +7,6 @@ population.  See :mod:`veles_tpu.genetics.core` for the GA engine and
 coordinator / worker over the existing Server/Client job protocol).
 """
 
-from .core import Chromosome, Population, collect_tunes  # noqa: F401
+from .core import (Chromosome, Population, applied_genes,  # noqa: F401
+                   collect_tunes)
 from .optimizer import GeneticsOptimizer, OptimizationWorkflow  # noqa: F401

@@ -18,10 +18,11 @@ drive the same object.
 """
 
 import collections
+import contextlib
 
 import numpy
 
-from ..config import Config, Tune
+from ..config import Config, Tune, override_scope
 from ..error import Bug
 from ..logger import Logger
 
@@ -61,6 +62,28 @@ def _concrete(tune, value):
             and isinstance(tune.max, int):
         return int(round(value))
     return float(value)
+
+
+@contextlib.contextmanager
+def applied_genes(root_node, tunes, genes):
+    """Gene overrides as a SCOPE: concrete values are written into the
+    config tree for the duration and the touched leaves restored
+    exactly (the ``Tune`` objects included) on exit.
+
+    :func:`apply_genes` mutates the global tree destructively — fine
+    for a subprocess evaluation that exits afterwards, but an
+    in-process multi-member evaluation (genetics standalone mode,
+    population lineage builds) leaks one chromosome's genes into the
+    next chromosome's run.  Every in-process evaluation path wraps the
+    run in this scope instead."""
+    if len(tunes) != len(genes):
+        raise Bug("gene/tune layout mismatch: %d tunes vs %d genes — "
+                  "coordinator and worker must run with identical "
+                  "Tune() config overrides" % (len(tunes), len(genes)))
+    overrides = {path: _concrete(tune, value)
+                 for (path, tune), value in zip(tunes, genes)}
+    with override_scope(root_node, overrides):
+        yield
 
 
 class Chromosome(object):

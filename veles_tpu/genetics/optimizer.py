@@ -32,16 +32,22 @@ from ..json_encoders import dump_json
 from ..launcher import Launcher
 from ..logger import Logger
 from ..workflow import Workflow
-from .core import Population, apply_genes, collect_tunes, _concrete
+from .core import Population, applied_genes, collect_tunes, _concrete
 
 
 def evaluate_chromosome(module, tunes, genes, seed,
                         fitness_key=FITNESS_KEY):
     """Runs the model module once with the chromosome's genes written
-    into the config tree; returns the fitness scalar."""
-    apply_genes(root, tunes, genes)
-    wf = run_workflow_module(module, seed=seed)
-    results = wf.gather_results()
+    into the config tree; returns the fitness scalar.
+
+    The genes apply as a SCOPE (snapshot + restore of the touched
+    leaves): the old destructive ``apply_genes`` call leaked one
+    chromosome's overrides into every later in-process evaluation —
+    a chromosome whose gene happened to match a sibling's stale value
+    would read as identical fitness."""
+    with applied_genes(root, tunes, genes):
+        wf = run_workflow_module(module, seed=seed)
+        results = wf.gather_results()
     if fitness_key not in results:
         raise Bug("model results carry no %r — the workflow needs an "
                   "IResultProvider exposing a fitness metric (the "

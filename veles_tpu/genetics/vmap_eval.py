@@ -213,11 +213,22 @@ class PopulationEvaluator(object):
         # epoch_number also resets: shuffle_limit compares against it,
         # and the per-generation walk must be byte-identical.
         loader.epoch_number = self._loader_epoch
+        # Traced training flag as a cached DEVICE constant — a numpy
+        # scalar argument would be an implicit host→device transfer
+        # on every dispatch (strict_step-clean steady state; the
+        # StepCompiler._training_flag pattern).
+        flags = getattr(self, "_training_flags_", None)
+        if flags is None:
+            flags = self._training_flags_ = (
+                jax.device_put(numpy.float32(0.0)),
+                jax.device_put(numpy.float32(1.0)))
         start_epoch = loader.epoch_number
         while loader.epoch_number - start_epoch < epochs:
             blocks = loader.serve_block(K)
-            cls = loader.minibatch_class
-            training = jnp.float32(1.0 if cls == TRAIN else 0.0)
+            # Static int: a numpy scalar class index would upload
+            # implicitly when it reaches the .at[] scatter below.
+            cls = int(loader.minibatch_class)
+            training = flags[1 if cls == TRAIN else 0]
             key = prng.get().jax_key()
             pop_params, pop_states = compiler._pop_block(
                 pop_params, pop_states,
@@ -234,9 +245,18 @@ class PopulationEvaluator(object):
                     min_err[cls] = numpy.minimum(min_err[cls], err)
                     saw_class[cls] = True
                 # Class epoch closed: zero its accumulator rows
-                # (DecisionGD._fetch_class_metrics parity).
+                # (DecisionGD._fetch_class_metrics parity) through a
+                # tiny jitted program cached per class — an eager
+                # .at[].set() materializes its index/value constants
+                # via implicit transfers on every epoch boundary
+                # (strict_step-clean steady state).
+                zero_acc = getattr(self, "_zero_acc_", None)
+                if zero_acc is None:
+                    zero_acc = self._zero_acc_ = jax.jit(
+                        lambda arr, c: arr.at[:, c].set(0.0),
+                        static_argnums=(1,))
                 for name in acc_keys:
-                    pop_states[name] = \
-                        pop_states[name].at[:, cls].set(0.0)
+                    pop_states[name] = zero_acc(pop_states[name],
+                                                cls)
         cls = VALID if saw_class[VALID] else TRAIN
         return 1.0 - min_err[cls]

@@ -504,6 +504,18 @@ class Launcher(Logger):
             serving = None
         if serving:
             payload["serving"] = serving
+        # Population row: member fitness, lineage generations, and
+        # exploit/requeue counts from any live population master in
+        # this process (docs/population.md).
+        try:
+            from .population.master import live_population_summary
+            population = live_population_summary()
+        except Exception as e:
+            self.debug("population heartbeat section unavailable: "
+                       "%s", e)
+            population = None
+        if population:
+            payload["population"] = population
         # Dashboard depth (reference: web_status.py:113-243 shows the
         # Graphviz workflow graph and plot links): the DOT text rides
         # the first beat and a ~per-minute refresh (the dashboard

@@ -1,0 +1,261 @@
+"""Member lineages: one long-lived weight/slot lineage per member.
+
+A lineage is a population member's COMPLETE training identity on the
+master: its own built-and-initialized workflow (weights, optimizer
+slots, loader position, decision metrics), its own PRNG registry
+(``prng.scoped`` — member A's shuffles and job keys never advance
+member B's streams), its per-member config overrides (GA genes,
+ensemble variation — applied through ``config.override_scope`` at
+build, restored after), and its job bookkeeping (the single in-flight
+job, requeued step keys, exploit-rebase markers).
+
+The bit-identity contract (docs/population.md): a member trained over
+the fleet is bit-identical to the same module trained standalone with
+the member's seed, because (a) the lineage workflow is built exactly
+the way a standalone run builds, (b) every job's RNG key is drawn
+from the member's own chain at serve time — the same draw sequence a
+standalone run makes — and shipped with the job, and (c) a dropped
+job's key is re-served with the requeued ticks, so chaos churn never
+forks the trajectory.
+"""
+
+import numpy
+
+from .. import prng
+from ..config import root, override_scope
+from ..error import Bug
+from ..harness import FITNESS_KEY
+from ..loader.base import VALID
+from ..logger import Logger
+from ..memory import Vector
+
+
+def build_member_workflow(module, seed, overrides=None):
+    """Builds + initializes a module workflow exactly like a
+    standalone run would (without running it), with per-member config
+    overrides applied around construction AND initialize — the same
+    scope mechanism :func:`veles_tpu.genetics.core.applied_genes`
+    uses, so overrides never leak into a sibling's build."""
+    from ..launcher import Launcher
+    state = {}
+
+    def load(WorkflowClass, **kwargs):
+        launcher = Launcher()
+        wf = WorkflowClass(launcher, **kwargs)
+        state["launcher"], state["wf"] = launcher, wf
+        return wf, False
+
+    def main(**kwargs):
+        state["launcher"].initialize(**kwargs)
+
+    with override_scope(root, overrides or {}):
+        prng.reset()
+        prng.get(0).seed(seed)
+        module.run(load, main)
+    if "wf" not in state:
+        raise Bug("workflow module %r never called load() — a "
+                  "population member cannot be built from it"
+                  % getattr(module, "__name__", module))
+    return state["wf"], state["launcher"]
+
+
+class Lineage(Logger):
+    """One member's weight/slot lineage plus its job bookkeeping.
+
+    Mutated only under the :class:`PopulationMaster` member-table
+    lock (the master's public entry points take it); the summary
+    accessors (:meth:`fitness`, :meth:`status_row`) read simple
+    floats/ints and are safe from the heartbeat thread.
+    """
+
+    def __init__(self, member_id, module, seed, overrides=None,
+                 hypers=None, origin="seed"):
+        super(Lineage, self).__init__()
+        self.member_id = member_id
+        self.module = module
+        self.seed = int(seed)
+        #: Per-member config overrides (dotted path → value): GA
+        #: genes, ensemble train_ratio, per-member snapshot prefixes.
+        self.overrides = dict(overrides or {})
+        #: Traced hyper overrides shipped with every job (leaf name →
+        #: float) — how member genes reach the worker's compiled step
+        #: without a per-member recompile.
+        self.hypers = dict(hypers or {})
+        self.origin = origin
+        #: PBT lineage generation: bumps on every exploit.
+        self.generation = 0
+        self.rng = {}           # the member's own prng registry
+        self.wf = None
+        self.launcher = None
+        # -- job bookkeeping (one job in flight at a time: folds are
+        # serialized per member, so the master's lineage is always
+        # exactly what the worker computed — the delta fold never has
+        # to compose concurrent updates for one member).
+        self.outstanding = None   # (slave, key) of the in-flight job
+        self.affinity = None      # preferred worker (delta locality)
+        self.last_served = 0.0
+        #: Keys of dropped jobs, re-served with the requeued ticks so
+        #: chaos churn keeps the trajectory bit-identical.
+        self.requeued_keys = []
+        self.jobs_done = 0
+        self.ticks_done = 0
+        #: Exploit-as-delta markers: worker id → leader member id,
+        #: recorded when the master adopted the leader's synced base
+        #: for that worker at exploit time.
+        self.exploit_rebase = {}
+        # -- fitness/health bookkeeping
+        self.val_epochs = 0
+        self.last_pbt_check = 0
+        self.fitness = None       # latest completed-epoch fitness
+        self.best_fitness = None
+        self.last_good = None     # (val_epochs, {key: array}) rollback
+        self.rollbacks = 0
+        self.retired = False
+
+    # -- construction ------------------------------------------------------
+
+    def build(self):
+        """Builds the member's workflow inside its own RNG scope —
+        init weight draws come from the member's seed, exactly like a
+        standalone run's."""
+        with prng.scoped(self.rng):
+            self.wf, self.launcher = build_member_workflow(
+                self.module, self.seed, self.overrides)
+        return self
+
+    @property
+    def built(self):
+        return self.wf is not None
+
+    def scope(self):
+        """The member's RNG scope; every lineage operation that can
+        draw randomness (loader walks, job-key draws, builds) runs
+        inside it."""
+        return prng.scoped(self.rng)
+
+    # -- job keys ----------------------------------------------------------
+
+    def draw_job_key(self):
+        """The job's step key: a requeued key first (a dropped job's
+        ticks re-serve with the key they were first served with),
+        else a fresh draw from the member's own chain — the same
+        position a standalone run would draw at this tick."""
+        if self.requeued_keys:
+            return self.requeued_keys.pop()
+        with self.scope():
+            return numpy.asarray(prng.get(0).jax_key())
+
+    def requeue_outstanding(self):
+        """Drops the in-flight job back onto the member: its key is
+        re-served with the loader's requeued ticks."""
+        if self.outstanding is None:
+            return False
+        self.requeued_keys.append(self.outstanding[1])
+        self.outstanding = None
+        return True
+
+    def retire(self):
+        """Frees the built workflow AND the last-good host snapshot
+        (GA lineages retire once their fitness is recorded — a long
+        GA run must not accumulate one model, or one guardian
+        snapshot, per evaluated chromosome)."""
+        self.wf = None
+        self.launcher = None
+        self.rng = {}
+        self.last_good = None
+        self.requeued_keys = []
+        self.retired = True
+
+    # -- fitness -----------------------------------------------------------
+
+    @property
+    def decision(self):
+        return getattr(self.wf, "decision", None) if self.wf else None
+
+    @property
+    def complete(self):
+        if self.retired:
+            return True
+        d = self.decision
+        if d is None:
+            return bool(self.wf.stopped) if self.wf else False
+        return bool(d.complete)
+
+    def refresh_fitness(self):
+        """Latest completed validation epoch → fitness (1 − err); the
+        same definition the Decision exports as ``EvaluationFitness``
+        for GA runs."""
+        d = self.decision
+        if d is None or not getattr(d, "epoch_metrics", None):
+            return self.fitness
+        err = d.epoch_metrics[VALID]
+        if err is None:
+            return self.fitness
+        self.fitness = 1.0 - float(err)
+        if self.best_fitness is None or \
+                self.fitness > self.best_fitness:
+            self.best_fitness = self.fitness
+        return self.fitness
+
+    def final_fitness(self):
+        """The run-level fitness a standalone evaluation would report
+        (``EvaluationFitness`` = 1 − min validation err)."""
+        results = self.wf.gather_results() if self.wf else {}
+        if FITNESS_KEY in results:
+            return float(results[FITNESS_KEY])
+        return self.fitness
+
+    # -- per-lineage guardian (rollback from the member's OWN
+    # last-good generation, never a sibling's) -----------------------------
+
+    def _state_vectors(self):
+        for unit in self.wf.units:
+            for which in ("trainables", "tstate"):
+                vecs = getattr(unit, which, None)
+                if not isinstance(vecs, dict):
+                    continue
+                for attr, vec in vecs.items():
+                    if isinstance(vec, Vector) and vec:
+                        yield "%s/%s" % (unit.name, attr), vec
+
+    def record_good(self):
+        """Snapshots the lineage's weights+slots host-side as the
+        member's last-good generation (called after a healthy
+        validation epoch)."""
+        snap = {}
+        for key, vec in self._state_vectors():
+            vec.map_read()
+            snap[key] = numpy.array(vec.mem)
+        self.last_good = (self.val_epochs, snap)
+
+    def rollback_last_good(self):
+        """Restores the member's own last-good weights/slots.  The
+        next job ships the restored values as an exact xor delta, so
+        the worker lands on them bit-for-bit.  Returns False when no
+        good generation was ever recorded."""
+        if self.last_good is None:
+            return False
+        epoch, snap = self.last_good
+        restored = 0
+        for key, vec in self._state_vectors():
+            src = snap.get(key)
+            if src is None or src.shape != vec.shape:
+                continue
+            vec.mem = numpy.array(src)
+            restored += 1
+        self.rollbacks += 1
+        self.info("member %s rolled back %d tensors to its own "
+                  "last-good generation (val epoch %d)",
+                  self.member_id, restored, epoch)
+        return True
+
+    # -- reporting ---------------------------------------------------------
+
+    def status_row(self):
+        row = {"generation": self.generation,
+               "jobs": self.jobs_done,
+               "ticks": self.ticks_done,
+               "val_epochs": self.val_epochs}
+        if self.fitness is not None:
+            row["fitness"] = round(self.fitness, 6)
+        return row

@@ -1,0 +1,80 @@
+"""On-chip sub-population backend: one device job evaluates a whole
+generation.
+
+Small members don't need the fleet: when every GA tune is a traced
+GD/optimizer hyperparameter, the existing vmapped population path
+(:mod:`veles_tpu.genetics.vmap_eval`) trains EVERY chromosome of a
+generation in one compiled program on one device.  This module
+promotes it to a population-engine scheduler backend: the engine
+hands a generation's gene matrix to :meth:`evaluate` and gets the
+fitness vector back — one "device job" per sub-population instead of
+one lineage per member.
+
+The evaluate loop is ``strict_step``-clean after the first
+generation: block uploads are explicit ``device_put``, the traced
+training flag is cached on device, and the only host syncs are the
+explicit epoch-boundary accumulator fetches
+(:mod:`veles_tpu.analysis.runtime` enforces this in the tier-1
+suite).
+"""
+
+from ..config import root, get as config_get
+from ..error import Bug
+
+
+class VmapSubPopulation(object):
+    """Generation evaluator backend over ``PopulationEvaluator``.
+
+    ``applicable(tunes)`` gates construction the same way the
+    genetics standalone path gates its vmap evaluator; the population
+    engine falls back to fleet lineages when it returns False.
+    """
+
+    def __init__(self, module, tunes, seed, epochs=None):
+        from ..genetics.vmap_eval import PopulationEvaluator
+        self._evaluator = PopulationEvaluator(module, tunes, seed,
+                                              epochs=epochs)
+        self.generations_evaluated = 0
+
+    @staticmethod
+    def applicable(module, tunes):
+        """True when the vmapped path can carry these tunes (every
+        leaf a uniquely-named GD/optimizer hyper) AND the config
+        enables it (``root.common.population.vmap``, default on —
+        mirroring ``root.common.genetics.vmap``)."""
+        from ..genetics.vmap_eval import hyper_names
+        if not bool(config_get(root.common.population.vmap, True)):
+            return False
+        return hyper_names(tunes) is not None
+
+    def evaluate(self, genes_matrix):
+        """Fitness vector for one generation's gene matrix — a single
+        vmapped device job over the whole sub-population."""
+        fitnesses = self._evaluator.evaluate(genes_matrix)
+        self.generations_evaluated += 1
+        from .. import resilience
+        resilience.stats.incr("population.vmap_generations")
+        return fitnesses
+
+    def run_population(self, population, log=None):
+        """Drives a genetics Population to completion, one vmapped
+        device job per generation (the population engine's GA mode
+        when the backend applies)."""
+        while not population.complete:
+            batch = []
+            while True:
+                got = population.acquire(owner="vmap")
+                if got is None:
+                    break
+                batch.append(got)
+            if not batch:
+                raise Bug("population stalled: nothing pending yet "
+                          "generation incomplete")
+            fitnesses = self.evaluate(
+                [genes for _, genes in batch])
+            for (index, _), fitness in zip(batch, fitnesses):
+                if log is not None:
+                    log("chromosome %d -> fitness %.6f", index,
+                        float(fitness))
+                population.record(index, float(fitness))
+        return population.best

@@ -1,0 +1,131 @@
+"""Worker side of the population engine: member contexts over ONE
+built model workflow.
+
+A worker serves many members of a population, but builds the module
+workflow ONCE: a population job establishes its member's state from
+the wire (weights full/delta, slot shards, loader indices, the
+member's step key and traced hypers), so the only per-member state
+the worker must keep is each member's delta-session sync bases — the
+``(_base_, version)`` snapshots ``ForwardBase``/``GradientDescentBase``
+expose through ``export_sync_state``/``import_sync_state``.  Those
+contexts are swapped around every job by member id, so lineages
+interleaved on one worker never cross-apply a delta.
+
+An ``exploit`` marker on a job (PBT exploit-as-delta,
+docs/population.md) re-bases the member's context on the LEADER's
+context this worker already holds, mirroring the master's synced-base
+adoption — the wire then carries only the xor delta between the
+member's new (copied) weights and the leader state already here.
+"""
+
+from .. import resilience
+from ..error import Bug
+from ..workflow import Workflow
+from .lineage import build_member_workflow
+from .master import population_checksum
+
+
+class PopulationWorker(Workflow):
+    """Executes member-tagged population jobs on a single built model
+    workflow (Client-drivable: the Server's counterpart is
+    :class:`veles_tpu.population.master.PopulationMaster`)."""
+
+    def __init__(self, launcher, module, seed=1234, **kwargs):
+        super(PopulationWorker, self).__init__(launcher, **kwargs)
+        self.module = module
+        self.build_seed = int(seed)
+        self.negotiates_on_connect = False
+        self._inner = None
+        self._contexts = {}   # member id -> {unit name: sync state}
+        self.jobs_done = 0
+
+    @property
+    def inner(self):
+        """The model workflow, built lazily with the module's default
+        config (member genes ride as traced hypers; weights and slots
+        come from the wire, so the build seed only shapes tensors)."""
+        if self._inner is None:
+            self._inner, _launcher = build_member_workflow(
+                self.module, self.build_seed)
+        return self._inner
+
+    @property
+    def checksum(self):
+        return population_checksum(self.module)
+
+    def note_net_proto(self, proto):
+        super(PopulationWorker, self).note_net_proto(proto)
+        self.inner.note_net_proto(proto)
+
+    # -- member contexts ---------------------------------------------------
+
+    def _sync_units(self):
+        for unit in self.inner.units:
+            if hasattr(unit, "export_sync_state"):
+                yield unit
+
+    def _export_context(self):
+        return {unit.name: unit.export_sync_state()
+                for unit in self._sync_units()}
+
+    def _install_context(self, ctx):
+        for unit in self._sync_units():
+            unit.import_sync_state(
+                ctx.get(unit.name) if ctx else None)
+
+    @staticmethod
+    def _copy_context(ctx):
+        """A member context copy for exploit re-basing: the base
+        dicts are copied (their arrays are rebound, never mutated in
+        place, so sharing them is safe)."""
+        out = {}
+        for name, state in ctx.items():
+            base, version = state or (None, None)
+            out[name] = (dict(base) if base is not None else None,
+                         version)
+        return out
+
+    def _adopt_exploit(self, member, leader):
+        """Re-bases ``member``'s context on ``leader``'s (the marker
+        only rides jobs whose master adopted the leader's synced base
+        for THIS worker, so a missing leader context means the
+        session desynchronized — the ordinary ProtocolError →
+        reconnect → full-rebase recovery handles it)."""
+        ctx = self._contexts.get(leader)
+        if ctx is None:
+            self.warning(
+                "exploit marker names member %r but this worker "
+                "holds no context for it — the delta will rebase "
+                "through the protocol-error reconnect path", leader)
+            resilience.stats.incr("population.exploit_miss")
+            return
+        self._contexts[member] = self._copy_context(ctx)
+        resilience.stats.incr("population.exploit_adopt")
+
+    # -- job execution -----------------------------------------------------
+
+    def do_job(self, data, update, callback):
+        member = (data or {}).get("m")
+        if member is None:
+            raise Bug("population job carries no member id — "
+                      "coordinator/worker build mismatch")
+        # Retire markers: the master announces recorded GA
+        # chromosomes so their sync contexts free here too (a long
+        # GA run must not hold one context per evaluated chromosome).
+        for retired in data.get("retire") or ():
+            self.drop_member(retired)
+        leader = data.get("exploit")
+        if leader is not None:
+            self._adopt_exploit(member, leader)
+        self._install_context(self._contexts.get(member))
+        try:
+            replies = []
+            self.inner.do_job(data["data"], None, replies.append)
+        finally:
+            self._contexts[member] = self._export_context()
+        self.jobs_done += 1
+        callback({"m": member, "data": replies[0]})
+
+    def drop_member(self, member):
+        """Forgets a member's context (a retired GA chromosome)."""
+        self._contexts.pop(member, None)

@@ -132,14 +132,27 @@ class RandomGenerator(Logger):
         chain position is part of the checkpointable state."""
         import jax
         if self._jax_key is None:
-            self._jax_key = jax.random.PRNGKey(self._jax_seed)
+            self._jax_key = jax.random.PRNGKey(
+                self._device_seed())
         self._jax_key, sub = jax.random.split(self._jax_key)
         return sub
+
+    def _device_seed(self):
+        """The 32-bit seed as an EXPLICIT device scalar: key
+        materialization can happen inside ``strict_step`` regions
+        (the vmap population evaluator reseeds per generation), where
+        PRNGKey's implicit host scalar upload would trip the
+        transfer guard.  Bit-identical to PRNGKey(int): the seed is
+        32-bit by construction, so the uint32 path yields the same
+        (0, seed) key words."""
+        import jax
+        return jax.device_put(numpy.uint32(self._jax_seed))
 
     def peek_jax_key(self):
         import jax
         if self._jax_key is None:
-            self._jax_key = jax.random.PRNGKey(self._jax_seed)
+            self._jax_key = jax.random.PRNGKey(
+                self._device_seed())
         return self._jax_key
 
     # -- state -------------------------------------------------------------
@@ -181,6 +194,31 @@ def get(key=0):
 
 def reset():
     _generators.clear()
+
+
+@contextlib.contextmanager
+def scoped(store):
+    """Temporarily installs ``store`` (a plain dict) as the process
+    generator registry, so a code region draws from its OWN generator
+    set instead of the shared one.
+
+    This is what gives population lineages (docs/population.md)
+    per-member randomness isolation in one process: each member owns a
+    full registry (host RandomState streams + jax key chains), and the
+    master enters the member's scope around every lineage operation —
+    builds, loader walks, job-key draws — so member A's shuffles never
+    advance member B's streams.  Generators created inside the scope
+    land in ``store``; the previous registry is restored on exit.
+    NOT thread-safe by itself: callers serialize lineage operations
+    (the population master runs them under the server workflow lock).
+    """
+    global _generators
+    saved = _generators
+    _generators = store
+    try:
+        yield store
+    finally:
+        _generators = saved
 
 
 # -- numpy.random poisoning (reproducibility guard) ---------------------

@@ -148,7 +148,7 @@ class WebStatusServer(JsonHttpServer):
     #: labeled Prometheus gauges on ``GET /metrics`` — ONE scrape
     #: endpoint covers every master this dashboard tracks.
     METRIC_SECTIONS = ("comms", "resilience", "perf", "serving",
-                      "metrics")
+                      "population", "metrics")
 
     def metrics_text(self):
         """Prometheus text exposition: this process's own registry
@@ -232,19 +232,28 @@ class WebStatusServer(JsonHttpServer):
                     "<tr><th>health</th><td%s>%s</td></tr>" %
                     (style, esc(json.dumps(health, sort_keys=True,
                                            default=str))))
+            # Population row: live per-member fitness + lineage
+            # generations from the population engine's heartbeat
+            # section (docs/population.md).
+            population = info.get("population")
+            population_row = (
+                "<tr><th>population</th><td>%s</td></tr>" %
+                esc(json.dumps(population, sort_keys=True))
+                if isinstance(population, dict) and population
+                else "")
             rows.append(
                 "<h2>%s <small>(%s)</small></h2>"
                 "<table><tr><th>mode</th><td>%s</td></tr>"
                 "<tr><th>epoch</th><td>%s</td></tr>"
                 "<tr><th>runtime</th><td>%.0f s</td></tr>"
-                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s%s%s"
+                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s%s%s%s"
                 "</table>" %
                 (esc(info.get("workflow", "?")), esc(mid),
                  esc(info.get("mode", "?")), esc(info.get("epoch", "?")),
                  runtime,
                  esc(json.dumps(info.get("metrics", {}))),
                  health_row, resilience_row, comms_row,
-                 serving_row, perf_row) +
+                 serving_row, perf_row, population_row) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
                  "</th><th>jobs</th><th>jobs/s</th></tr>%s</table>"
                  % wtable if workers else "") +

@@ -331,6 +331,63 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
         self._shipped_.pop(slave, None)
         self._synced_.pop(slave, None)
 
+    # -- population member contexts (docs/population.md) -------------------
+
+    def export_sync_state(self):
+        """Worker side: this unit's delta-session base (arrays +
+        version) as an opaque snapshot.  The population worker swaps
+        these per member id around every job, so lineages interleaved
+        on one worker never cross-apply a delta against a sibling's
+        base.  Arrays are rebound, never mutated in place, so the
+        snapshot stays valid while another member is installed."""
+        return (self._base_, self._base_version_)
+
+    def import_sync_state(self, state):
+        """Worker side: installs a member's delta-session base
+        (``None`` state = fresh member, forces a full-ship sync)."""
+        self._base_, self._base_version_ = state or (None, None)
+
+    def adopt_synced_from(self, src, slave):
+        """Master side, exploit-as-delta (docs/population.md): seeds
+        this lineage unit's synced base for ``slave`` with the LEADER
+        lineage unit's — after an exploit copied the leader's
+        last-shipped weights here, the next job to that worker ships
+        only the (collapsing) xor delta against a base the worker
+        already holds for the leader, instead of a full weight ship.
+        Returns False when the leader has no synced base at that
+        worker, None when this unit has nothing to sync at all."""
+        if not self.trainables:
+            return None
+        prev = src._synced_.get(slave)
+        if prev is None:
+            return False
+        version, arrays = prev
+        self._synced_[slave] = (version, dict(arrays))
+        return True
+
+    def adopt_shipped_values(self, src, slave):
+        """Master side: overwrites this lineage unit's trainables
+        with the values the LEADER unit last SHIPPED to ``slave``
+        (its synced base there).  The exploit copies exactly the
+        generation the worker already holds, so the follow-up delta
+        ship collapses to unchanged-None markers.  Returns False when
+        the leader has no synced base at that worker, None when not
+        applicable."""
+        import numpy
+        if not self.trainables:
+            return None
+        prev = src._synced_.get(slave)
+        if prev is None:
+            return False
+        _version, arrays = prev
+        for attr, vec in self.trainables.items():
+            arr = arrays.get(attr)
+            if arr is None or arr.shape != vec.shape:
+                return False
+            vec.map_write()
+            vec.mem = numpy.array(arr)
+        return True
+
 
 class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
     """Per-layer trainer (znicz ``GradientDescentBase`` analogue).
@@ -828,6 +885,51 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
 
     def drop_slave(self, slave=None):
         self._slot_synced_.pop(slave, None)
+
+    # -- population member contexts (docs/population.md) -------------------
+
+    def export_sync_state(self):
+        """Worker side: the slot-shard sync base, mirroring
+        ``ForwardBase.export_sync_state`` (population member-context
+        swaps cover optimizer slots the same way they cover
+        weights)."""
+        return (self._slot_base_, self._slot_base_version_)
+
+    def import_sync_state(self, state):
+        self._slot_base_, self._slot_base_version_ = \
+            state or (None, None)
+
+    def adopt_synced_from(self, src, slave):
+        """Master side: exploit-as-delta for the slot shards (see
+        ``ForwardBase.adopt_synced_from``)."""
+        if not self.tstate:
+            return None
+        prev = src._slot_synced_.get(slave)
+        if prev is None:
+            return False
+        version, arrays = prev
+        self._slot_synced_[slave] = (version, dict(arrays))
+        return True
+
+    def adopt_shipped_values(self, src, slave, rank=0, dp=1):
+        """Master side: overwrites this unit's slot shard with the
+        values the leader last synced to ``slave`` (see
+        ``ForwardBase.adopt_shipped_values``)."""
+        if not self.tstate:
+            return None
+        prev = src._slot_synced_.get(slave)
+        if prev is None:
+            return False
+        _version, arrays = prev
+        for slot, arr in arrays.items():
+            vec = self.tstate.get(slot)
+            if vec is None:
+                return False
+            lo, hi = self._shard_bounds(vec, rank, dp)
+            if hi - lo != arr.size:
+                return False
+            self._store_shard(slot, arr, rank, dp)
+        return True
 
     def _slave_proto(self, slave):
         return _proto_of_slave(self, slave)
