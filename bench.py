@@ -48,6 +48,15 @@ reports tok/s per mode, accept rate, tokens/step, draft/verify
 latencies, and rewound blocks; ``vs_baseline`` is adaptive-spec over
 plain paged decode on the same workload.
 
+``python bench.py --elastic`` runs the elastic-fleet control-plane
+bench (docs/distributed.md "Elastic operations"): a real loopback
+socket fleet walks 4→2→4 workers mid-run — two workers drain on a
+preemption notice, two late joiners full-ship in — while a trivial
+job ledger streams through.  Reports sustained jobs/s across the
+walk, late-join latency (dial → first job applied), and the
+membership ledger (epochs, joins, drains; zero drops is the pass
+condition).  ``--elastic-jobs=N`` sizes the ledger (default 400).
+
 ``python bench.py --streamed-jpeg`` decodes REAL JPEG files (a
 synthetic directory tree written once) through the streamed loader's
 host worker pool — decode + double-buffered upload + fused dispatch
@@ -89,7 +98,7 @@ BENCH_FLAGS = ("--mlp", "--lm", "--lm-toy", "--serve", "--streamed",
                "--trace-out", "--optimizer", "--pp-schedule",
                "--moe-topk", "--moe-experts", "--population",
                "--population-members", "--population-epochs",
-               "--population-ticks")
+               "--population-ticks", "--elastic", "--elastic-jobs")
 
 # Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
 # or blocks gain <3% more.  The perf levers that got here: banded-
@@ -1372,6 +1381,146 @@ def population_bench(argv):
     }))
 
 
+def elastic_bench(argv):
+    """``--elastic``: membership-walk bench over the REAL socket
+    fleet (docs/distributed.md "Elastic operations").  A trivial job
+    ledger streams through a loopback coordinator while the fleet
+    walks 4→2→4: at 25% done two workers receive a preemption notice
+    and drain (finish in-flight work, goodbye, thread exits), at 50%
+    two fresh workers dial in and are full-shipped.  Reports jobs/s
+    sustained across the whole walk, late-join latency, and the
+    membership ledger — clean goodbyes only, zero drops."""
+    import threading
+
+    from veles_tpu import resilience
+    from veles_tpu.client import Client
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.observability import metrics
+    from veles_tpu.server import Server
+    from veles_tpu.units import TrivialUnit
+    from veles_tpu.workflow import Workflow
+
+    total = 400
+    for arg in argv:
+        if arg.startswith("--elastic-jobs="):
+            total = int(arg.split("=", 1)[1])
+
+    class _Ledger(Workflow):
+        """Echo-job ledger: the bench measures the control plane
+        (dispatch + fold + membership), not device math."""
+
+        def __init__(self, launcher, total_jobs=0, **kwargs):
+            super(_Ledger, self).__init__(launcher, **kwargs)
+            self.body = TrivialUnit(self)
+            self.body.link_from(self.start_point)
+            self.end_point.link_from(self.body)
+            self.total_jobs = total_jobs
+            self.next_job = 1
+            self.done = {}
+            self.outstanding = {}
+            self.requeued = []
+            self.jobs_run = 0
+
+        def generate_data_for_slave(self, slave=None):
+            if self.requeued:
+                n = self.requeued.pop(0)
+            elif self.next_job <= self.total_jobs:
+                n = self.next_job
+                self.next_job += 1
+            else:
+                return None
+            self.outstanding.setdefault(slave, []).append(n)
+            return {"n": n}
+
+        def apply_data_from_slave(self, data, slave=None):
+            n = data["echo"]
+            lst = self.outstanding.get(slave, [])
+            if n in lst:
+                lst.remove(n)
+                self.done[n] = self.done.get(n, 0) + 1
+
+        def drop_slave(self, slave=None):
+            self.requeued.extend(self.outstanding.pop(slave, []))
+
+        def should_stop_serving(self):
+            return (len(self.done) >= self.total_jobs and
+                    not self.requeued and
+                    not any(self.outstanding.values()))
+
+        def do_job(self, data, update, callback):
+            self.jobs_run += 1
+            callback({"echo": data["n"]})
+
+    def start_worker():
+        slave = _Ledger(Launcher())
+        client = Client(addr, slave, reconnect_attempts=100,
+                        reconnect_delay=0.02)
+        thread = threading.Thread(target=client.run, daemon=True)
+        t_dial = time.time()
+        thread.start()
+        return {"client": client, "thread": thread, "slave": slave,
+                "dialed": t_dial}
+
+    def wait_done(threshold, deadline=60.0):
+        limit = time.time() + deadline
+        while len(master.done) < threshold and time.time() < limit:
+            time.sleep(0.002)
+
+    master = _Ledger(Launcher(), total_jobs=total)
+    server = Server(":0", master)
+    addr = "127.0.0.1:%d" % server.port
+    t0 = time.time()
+    workers = [start_worker() for _ in range(4)]
+
+    def watch_first_job(w):
+        # Stamp dial → first job applied on the worker; runs beside
+        # the join so the stamp is not smeared by the rest of the run.
+        while not w["slave"].jobs_run and not w["client"]._stop:
+            time.sleep(0.0005)
+        w["first_job"] = time.time()
+
+    wait_done(total // 4)
+    for w in workers[2:]:
+        w["client"].drain()
+    wait_done(total // 2)
+    joiners = [start_worker() for _ in range(2)]
+    watchers = [threading.Thread(target=watch_first_job, args=(w,),
+                                 daemon=True) for w in joiners]
+    for t in watchers:
+        t.start()
+    server.wait(timeout=120)
+    wall = time.time() - t0
+
+    server.stop()
+    for w in workers + joiners:
+        w["thread"].join(timeout=5)
+    for t in watchers:
+        t.join(timeout=5)
+    join_ms = [(w["first_job"] - w["dialed"]) * 1e3
+               for w in joiners if "first_job" in w]
+
+    snap = server.fleet.snapshot()
+    print(json.dumps({
+        "metric": "elastic_jobs_per_sec",
+        "value": round(total / wall, 1),
+        "unit": "jobs/sec",
+        "jobs": total,
+        "wall_s": round(wall, 3),
+        "walk": "4->2->4",
+        "exactly_once": all(v == 1 for v in master.done.values()),
+        "membership_epoch": snap["epoch"],
+        "joins": snap["joins"],
+        "drains": snap["drains"],
+        "goodbyes": resilience.stats.get("server.goodbye"),
+        "drops": resilience.stats.get("server.drop"),
+        "requeues": resilience.stats.get("server.requeue"),
+        "join_latency_ms": (round(max(join_ms), 1)
+                            if join_ms else None),
+        "epoch_gauge": getattr(
+            metrics.registry.peek("membership.epoch"), "value", None),
+    }))
+
+
 def attribution_fields():
     """Live device-time/MFU gauge readings for the bench JSON line
     (the BENCH_r06 per-stage attribution record)."""
@@ -1397,6 +1546,9 @@ def main():
         return
     if any(a.startswith("--population") for a in sys.argv):
         population_bench(sys.argv)
+        return
+    if any(a.startswith("--elastic") for a in sys.argv):
+        elastic_bench(sys.argv)
         return
     if "--serve" in sys.argv:
         serve_bench(sys.argv)

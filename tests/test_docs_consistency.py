@@ -38,7 +38,7 @@ CHECKED_PREFIXES = frozenset((
     "snapshot", "step", "serving", "guardian", "device", "kv",
     "requests", "batches", "tokens", "rejected", "cancelled",
     "stalled", "warmup", "ttft", "itl", "perf", "optimizer", "moe",
-    "spec", "drained", "population", "pbt",
+    "spec", "drained", "population", "pbt", "fleet", "membership",
 ))
 
 

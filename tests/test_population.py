@@ -281,6 +281,61 @@ def test_worker_drop_mid_generation_requeues_and_parity():
                              "member %s chaos-vs-clean" % m.member_id)
 
 
+def test_worker_leave_join_cycle_keeps_step_keys_and_fitness():
+    """Elastic churn, the PLANNED flavor (ISSUE 16): a worker that
+    completes its job and leaves CLEANLY between jobs (a preemption
+    drain) requeues nothing — no step key is re-minted — and the
+    joiner that replaces it drives the population to a fitness table
+    and lineage states bit-identical to an un-churned run."""
+    from veles_tpu.population import PopulationMaster, PopulationWorker
+    from veles_tpu.population.engine import loopback_proto
+    module = _module()
+    root.mnist.max_epochs = 2
+    proto = loopback_proto()
+
+    def build():
+        return PopulationMaster(Launcher(), module, mode="train",
+                                size=2, seed=SEED)
+
+    # Un-churned single-worker reference run.
+    clean = build()
+    w_ref = PopulationWorker(Launcher(), module, seed=SEED)
+    _drive_loopback(clean, {"w2": w_ref}, proto)
+    ref_fits = {m.member_id: m.fitness for m in clean.members}
+    ref_state = {m.member_id: _final_state(m.wf)
+                 for m in clean.members}
+
+    # Churn run: w1 serves ONE job to completion, ships the update,
+    # then leaves cleanly; w2 joins and takes over.
+    master = build()
+    w1 = PopulationWorker(Launcher(), module, seed=SEED)
+    w2 = PopulationWorker(Launcher(), module, seed=SEED)
+    master.note_slave_protocol("w1", proto)
+    w1.note_net_proto(proto)
+    job = master.generate_data_for_slave("w1")
+    assert job is not None
+    replies = []
+    w1.do_job(job, None, replies.append)
+    master.apply_data_from_slave(replies[0], "w1")
+    before = resilience.stats.snapshot().get(
+        "population.requeues", 0)
+    master.drop_slave("w1")  # the drained leave: nothing in flight
+    assert resilience.stats.snapshot().get(
+        "population.requeues", 0) == before, \
+        "a clean leave must requeue nothing"
+    member = master._members[job["m"]]
+    assert not member.requeued_keys, \
+        "a clean leave re-minted a step key"
+    _drive_loopback(master, {"w2": w2}, proto)
+    assert {m.member_id: m.fitness
+            for m in master.members} == ref_fits
+    for m in master.members:
+        _assert_states_equal(ref_state[m.member_id],
+                             _final_state(m.wf),
+                             "member %s leave-join-vs-clean"
+                             % m.member_id)
+
+
 # -- PBT loopback: exploit-as-delta + observability surfaces ------------
 
 

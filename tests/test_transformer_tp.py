@@ -296,6 +296,60 @@ def test_rebuild_preserves_three_axis_style():
     assert wf.gather_results()["epochs"] == 4
 
 
+def test_rebuild_partial_fit_8_to_6_keeps_two_axis_style():
+    """VERDICT item 9: 8→6 on dp×tp×sp 2×2×2 — 6 is not divisible by
+    model·seq (4), but a 2-axis style must survive the shrink: the
+    ladder keeps tp and drops sp → dp_tp 3×2, never the pure-DP
+    cliff."""
+    import jax
+    launcher, wf = _build_tinylm(max_epochs=2, seq_axis="seq")
+    apply_dp_tp_sp_sharding(
+        wf, make_mesh(jax.devices(),
+                      {"data": 2, "model": 2, "seq": 2}))
+    launcher._finished.clear()
+    wf.run()
+    from veles_tpu.parallel import rebuild_mesh
+    rebuild_mesh(wf, jax.devices()[:6])
+    assert wf._parallel_style_[0] == "dp_tp", wf._parallel_style_
+    assert wf.mesh.shape == {"data": 3, "model": 2}
+    wf.decision.max_epochs = 4
+    wf.decision.complete <<= False
+    wf._finished_.clear()
+    wf.run()
+    assert wf.gather_results()["epochs"] == 4
+    some_param = next(iter(wf.compiler._param_vecs.values()))
+    assert len(some_param.devmem.sharding.device_set) == 6
+
+
+def test_rebuild_growth_widens_data_axis_and_stamps_epoch():
+    """Membership GROWTH (ISSUE 16): 4→8 devices re-forms dp×sp with
+    the seq axis at its exact old size and the data axis doubled; the
+    explicit membership epoch stamps the workflow and the grow
+    counter ticks."""
+    import jax
+    import veles_tpu.resilience as resilience
+    from veles_tpu.parallel import apply_dp_sp_sharding, rebuild_mesh
+    launcher, wf = _build_tinylm(max_epochs=2, seq_axis="seq")
+    apply_dp_sp_sharding(wf, make_mesh(jax.devices()[:4],
+                                       {"data": 2, "seq": 2}))
+    launcher._finished.clear()
+    wf.run()
+    before = resilience.stats.snapshot().get("membership.grow", 0)
+    rebuild_mesh(wf, jax.devices(), epoch=17)
+    assert wf._parallel_style_[0] == "dp_sp"
+    assert wf.mesh.shape == {"data": 4, "seq": 2}
+    assert wf._membership_epoch_ == 17
+    assert resilience.stats.snapshot().get(
+        "membership.grow", 0) == before + 1
+    wf.decision.max_epochs = 4
+    wf.decision.complete <<= False
+    wf._finished_.clear()
+    wf.run()
+    assert wf.gather_results()["epochs"] == 4
+    some_param = next(iter(wf.compiler._param_vecs.values()))
+    assert len(some_param.devmem.sharding.device_set) == 8
+
+
 def test_rebuild_falls_back_to_dp_when_indivisible():
     """3 survivors cannot hold any 2-axis style — plain DP with a
     warning, never a crash."""

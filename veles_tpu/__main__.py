@@ -168,6 +168,8 @@ class Main(Logger, CommandLineBase):
             out += ["--reconnect-attempts", str(a.reconnect_attempts)]
         if a.reconnect_delay is not None:
             out += ["--reconnect-delay", str(a.reconnect_delay)]
+        if a.preempt_grace is not None:
+            out += ["--preempt-grace", str(a.preempt_grace)]
         if a.chaos:
             # Workers install the SAME plan: each process's rules
             # fire off its own logical counters, so the combined
@@ -223,6 +225,9 @@ class Main(Logger, CommandLineBase):
             if self.args.reconnect_delay is not None:
                 slave_kwargs["reconnect_delay"] = \
                     self.args.reconnect_delay
+            if self.args.preempt_grace is not None:
+                slave_kwargs["preempt_grace"] = \
+                    self.args.preempt_grace
             if self.args.net_legacy:
                 slave_kwargs["net_legacy"] = True
             if slave_kwargs:

@@ -11,15 +11,18 @@ injection ``:302-307,438-442``, bounded reconnect attempts
 import collections
 import os
 import random
+import threading
 import time
 
 from . import resilience
+from .config import root, get as config_get
 from .logger import Logger
 from .network_common import (Channel, connect, machine_id,
                              normalize_secret)
 from .observability import tracing
 from .resilience import (HandshakeRejected, ProtocolError,
-                         RetryPolicy, WorkerHang, WorkerKilled)
+                         RetryPolicy, WorkerHang, WorkerKilled,
+                         WorkerPreempted)
 
 #: Wire capabilities this worker advertises in its handshake
 #: (docs/distributed.md).  An old master simply ignores the key.
@@ -58,6 +61,38 @@ def init_parser(parser):
         "--reconnect-delay", type=float, default=None, metavar="SEC",
         help="base reconnect backoff in seconds (default 0.2; grows "
              "exponentially with seeded jitter, capped at 30s)")
+    parser.add_argument(
+        "--preempt-grace", type=float, default=None, metavar="SEC",
+        help="planned-departure budget: on SIGTERM (spot preemption) "
+             "the worker finishes its in-flight job, ships the "
+             "update, sends the bye frame, and exits 0; past this "
+             "many seconds the drain degrades to an abrupt drop and "
+             "the master requeues the work (default 30)")
+
+
+def install_sigterm_drain(client, grace=None):
+    """SIGTERM → planned departure → exit 0 (the supervisor-facing
+    preemption contract, mirroring the serving engine's
+    ``serve.install_sigterm_drain``): the in-flight job finishes,
+    the update ships, the ``bye`` frame goes out, and the worker
+    process exits cleanly instead of dying mid-recv.  The drain runs
+    on a helper thread — signal handlers must return quickly.
+    ``grace`` overrides the client's ``--preempt-grace`` budget;
+    past it the drain degrades to an abrupt drop (the master
+    requeues, exactly as for a crash).  No-op outside the main
+    thread (tests drive clients from worker threads)."""
+    import signal
+
+    def on_term(_signum, _frame):
+        threading.Thread(
+            target=lambda: client.drain(
+                client.preempt_grace if grace is None else grace),
+            daemon=True, name="veles-sigterm-drain").start()
+
+    try:
+        signal.signal(signal.SIGTERM, on_term)
+    except ValueError:
+        pass  # not the main thread
 
 
 def measure_computing_power(repeats=2, n=1024):
@@ -163,9 +198,54 @@ class Client(Logger):
         #: Periodic power re-measurement (reference: client.py:308-313).
         self.power_interval = float(kwargs.get("power_interval", 60.0))
         self._power_measured = 0.0
+        #: Planned-departure grace budget (``--preempt-grace``): how
+        #: long a drain may take before it degrades to an abrupt
+        #: drop (see :meth:`drain`).
+        self.preempt_grace = kwargs.get("preempt_grace")
+        if self.preempt_grace is None:
+            self.preempt_grace = config_get(
+                root.common.client.preempt_grace, 30.0)
+        self._draining = False
+        self._drain_done = threading.Event()
+        #: The live channel (for the drain watchdog's degrade path:
+        #: severing it makes the master see a dead peer and requeue).
+        self._chan = None
 
     def stop(self):
         self._stop = True
+
+    def drain(self, grace=None):
+        """Begins a planned departure (SIGTERM, scale-down, the
+        ``worker.preempt`` chaos fault): the in-flight job finishes,
+        its update ships, the ``bye`` frame goes out, and
+        :meth:`run` returns normally — the master records a clean
+        retirement (``server.goodbye``), not a drop.  Past ``grace``
+        seconds the drain degrades to today's crash handling: the
+        channel is severed, the master requeues our in-flight work,
+        and a CLI worker exits nonzero."""
+        if self._draining:
+            return
+        self._draining = True
+        resilience.stats.incr("client.drain")
+        self.info("draining: finishing in-flight work, then leaving")
+        if grace is not None and grace > 0:
+            threading.Thread(target=self._drain_watchdog,
+                             args=(grace,), daemon=True,
+                             name="veles-drain-watchdog").start()
+
+    def _drain_watchdog(self, grace):
+        if self._drain_done.wait(grace):
+            return
+        self.warning("drain grace budget (%.1fs) exhausted — "
+                     "degrading to an abrupt drop (the master "
+                     "requeues our in-flight work)", grace)
+        resilience.stats.incr("client.drain_expired")
+        self._stop = True
+        chan = self._chan
+        if chan is not None:
+            chan.close()
+        if self.death_exits:
+            os._exit(1)
 
     def _injector_(self):
         return resilience.effective(self.injector)
@@ -177,6 +257,15 @@ class Client(Logger):
         (exponential backoff + seeded jitter); the attempt counter
         resets on every successful handshake, so a long-lived worker
         survives any number of transient master outages."""
+        try:
+            self._run()
+        finally:
+            # Whatever the exit path — orderly bye, give-up, hard
+            # stop — the drain is over; the grace watchdog must not
+            # fire after it.
+            self._drain_done.set()
+
+    def _run(self):
         attempts = 0
         policy = self.retry_policy
         while not self._stop:
@@ -186,6 +275,7 @@ class Client(Logger):
                 sock = connect(self.address, timeout=30.0)
                 chan = Channel(sock, self._secret,
                                injector=self.injector)
+                self._chan = chan
                 if self._handshake(chan):
                     attempts = 0
                     cycle = (self._job_cycle_async if self.async_mode
@@ -231,9 +321,13 @@ class Client(Logger):
                 # diagnostics.
                 self.warning("worker session aborted: %r", e)
             finally:
+                self._chan = None
                 if chan is not None:
                     chan.close()
-            if self._stop:
+            if self._stop or self._draining:
+                # A draining session does not reconnect: the planned
+                # departure already happened (or its channel died
+                # trying) — redialing would rejoin just to leave.
                 return
             attempts += 1
             if attempts > policy.max_attempts:
@@ -248,7 +342,8 @@ class Client(Logger):
         responsive — backoff sleeps reach 30 s each, and a shutdown
         must not wait one out."""
         deadline = time.time() + seconds
-        while not self._stop and time.time() < deadline:
+        while not self._stop and not self._draining and \
+                time.time() < deadline:
             time.sleep(0.05)
 
     def _say_goodbye(self, chan):
@@ -348,6 +443,8 @@ class Client(Logger):
             if cmd == "update_ack":
                 continue
             if cmd == "no_job":
+                if self._draining:
+                    break  # the pipeline is empty: leave now
                 self._nojob_backoff()
                 chan.send({"cmd": "job_request"})
                 sent_at.append(time.time())
@@ -357,13 +454,23 @@ class Client(Logger):
             self._nojob_streak = 0
             inj = self._injector_()
             inj.tick("job")
-            inj.check("worker.job")
-            # Pipeline: request N+1 BEFORE computing N.
-            chan.send({"cmd": "job_request"})
-            sent_at.append(time.time())
+            try:
+                inj.check("worker.job")
+            except WorkerPreempted:
+                self.warning("preemption notice — draining after the "
+                             "in-flight job")
+                resilience.stats.incr("client.preempt")
+                self.drain(self.preempt_grace)
+            # Pipeline: request N+1 BEFORE computing N — unless we
+            # are draining, in which case the pipeline empties out.
+            if not self._draining:
+                chan.send({"cmd": "job_request"})
+                sent_at.append(time.time())
             update, spans = self._traced_job(msg, trace_on)
             chan.send(self._update_msg(update, spans))
             self._maybe_remeasure_power(chan)
+            if self._draining and not sent_at:
+                break  # last pipelined update shipped: leave
         self._say_goodbye(chan)
         return True
 
@@ -435,6 +542,8 @@ class Client(Logger):
         """Returns True on orderly completion."""
         trace_on = bool(chan.proto.get("trace"))
         while not self._stop:
+            if self._draining:
+                break  # planned departure: bye instead of a request
             send_ts = time.time()
             chan.send({"cmd": "job_request"})
             msg = chan.recv()
@@ -455,7 +564,16 @@ class Client(Logger):
             self._nojob_streak = 0
             inj = self._injector_()
             inj.tick("job")
-            inj.check("worker.job")
+            try:
+                inj.check("worker.job")
+            except WorkerPreempted:
+                # Planned preemption: NOT a crash.  This job still
+                # runs, its update still ships; the bye goes out
+                # right after the ack.
+                self.warning("preemption notice — draining after the "
+                             "in-flight job")
+                resilience.stats.incr("client.preempt")
+                self.drain(self.preempt_grace)
             update, spans = self._traced_job(msg, trace_on)
             chan.send(self._update_msg(update, spans))
             ack = chan.recv()

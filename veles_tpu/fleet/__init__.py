@@ -1,0 +1,10 @@
+"""Elastic fleet membership: epoch-numbered join/leave events and the
+one scheduler every job kind (training lineages, eval ticks, warm
+serving replicas, respawns) places through.  See
+:mod:`veles_tpu.fleet.scheduler`.
+"""
+
+from .scheduler import (  # noqa: F401
+    FleetScheduler,
+    live_fleet_summary,
+)

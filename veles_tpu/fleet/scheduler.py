@@ -1,0 +1,220 @@
+"""Fleet-wide membership accounting and placement.
+
+Membership change is a NORMAL event here, not an exception path: every
+join and leave bumps a monotonically increasing **membership epoch**
+that downstream consumers key off — ``rebuild_mesh`` stamps the epoch
+on the workflow when it re-forms the device mesh, the launcher
+heartbeat ships it in the ``fleet`` section, and ``web_status`` /
+``GET /metrics`` expose it as the ``membership.epoch`` gauge.  An
+operator (or a test) can therefore answer "did the fleet change shape,
+and when?" without diffing worker logs.
+
+:class:`FleetScheduler` also unifies the three bespoke placement
+policies that grew independently across the control plane:
+
+* rank assignment for joiners — the server's lowest-free-shard-rank
+  rule (:meth:`FleetScheduler.lowest_free_rank`);
+* affinity scheduling — the population engine's
+  affinity-first / fresh-next / steal-oldest member pick
+  (:meth:`FleetScheduler.pick_affine`), which keeps a lineage's ticks
+  on the worker already holding its synced base so jobs ride the
+  delta plane instead of a full ship;
+* respawn/replica placement — the launcher's least-loaded-node rule
+  (:meth:`FleetScheduler.least_loaded`).
+
+Training lineages, eval ticks, and warm serving replicas all flow
+through the same primitives, so "which worker should take this?" has
+one answer per policy rather than one per subsystem.
+
+Leaves are classified: a **drain** (the worker finished its in-flight
+job, shipped the update, and said ``bye`` — planned preemption, scale
+down) versus a **drop** (crash, hang, dead peer).  The distinction is
+what makes preemption cheap: a drained leave requeues nothing, so the
+tick order — and therefore the bit-parity trajectory — is preserved
+across a fleet walk.
+
+Counters (``resilience.stats``): ``fleet.join``, ``fleet.leave``,
+``fleet.drain``.  Gauges (process metrics registry):
+``membership.epoch``, ``fleet.size``.
+"""
+
+import threading
+import time
+import weakref
+
+from collections import deque
+
+from .. import resilience
+
+
+#: Live schedulers in this process, feeding the launcher-heartbeat
+#: "fleet" section and the web_status fleet row (mirrors the
+#: population engine's live-master registry).
+_LIVE_SCHEDULERS = weakref.WeakSet()
+
+
+def live_fleet_summary():
+    """Aggregate across this process's live fleet schedulers for the
+    heartbeat ``fleet`` section, or None when no membership event has
+    happened yet (a quiet section beats a row of zeros)."""
+    scheds = [s for s in list(_LIVE_SCHEDULERS) if s.epoch > 0]
+    if not scheds:
+        return None
+    out = {"schedulers": len(scheds), "epoch": 0, "size": 0,
+           "joins": 0, "leaves": 0, "drains": 0}
+    last = None
+    for sched in scheds:
+        snap = sched.snapshot()
+        out["epoch"] = max(out["epoch"], snap["epoch"])
+        out["size"] += snap["size"]
+        out["joins"] += snap["joins"]
+        out["leaves"] += snap["leaves"]
+        out["drains"] += snap["drains"]
+        if snap.get("last_event") is not None:
+            if last is None or snap["last_event"][0] > last[0]:
+                last = snap["last_event"]
+    if last is not None:
+        out["last_event"] = list(last)
+    return out
+
+
+class FleetScheduler(object):
+    """Epoch-numbered membership registry + shared placement policy.
+
+    Thread-safe: the server's per-slave threads call :meth:`join` /
+    :meth:`leave` concurrently with heartbeat snapshots.  The
+    placement primitives are static — they encode policy, not state —
+    so subsystems with their own bookkeeping (the population master's
+    member table, the launcher's process table) can reuse the policy
+    without adopting this registry.
+    """
+
+    #: Event-ring depth: enough to reconstruct a full chaos-soak walk
+    #: from the heartbeat, small enough to ship in every beat.
+    MAX_EVENTS = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.epoch = 0
+        self.members = {}  # sid -> {"mid", "power", "joined", "epoch"}
+        self.joins = 0
+        self.leaves = 0
+        self.drains = 0
+        self.events = deque(maxlen=self.MAX_EVENTS)
+        _LIVE_SCHEDULERS.add(self)
+
+    # -- membership --------------------------------------------------------
+
+    def join(self, sid, mid=None, power=1.0):
+        """Admits ``sid``; returns the new membership epoch."""
+        with self._lock:
+            self.epoch += 1
+            self.joins += 1
+            self.members[sid] = {
+                "mid": mid, "power": power,
+                "joined": time.time(), "epoch": self.epoch}
+            self.events.append((self.epoch, "join", sid))
+            epoch = self.epoch
+        resilience.stats.incr("fleet.join")
+        self._publish_gauges()
+        return epoch
+
+    def leave(self, sid, clean=False):
+        """Retires ``sid``; returns the new membership epoch.
+
+        ``clean`` marks a drain (orderly ``bye``) rather than a drop;
+        an sid that never joined (handshake died before admission)
+        leaves the epoch untouched.
+        """
+        with self._lock:
+            if self.members.pop(sid, None) is None:
+                return self.epoch
+            self.epoch += 1
+            self.leaves += 1
+            if clean:
+                self.drains += 1
+            self.events.append(
+                (self.epoch, "drain" if clean else "drop", sid))
+            epoch = self.epoch
+        resilience.stats.incr("fleet.leave")
+        if clean:
+            resilience.stats.incr("fleet.drain")
+        self._publish_gauges()
+        return epoch
+
+    @property
+    def size(self):
+        return len(self.members)
+
+    def snapshot(self):
+        """The heartbeat ``fleet`` section payload."""
+        with self._lock:
+            out = {"epoch": self.epoch, "size": len(self.members),
+                   "joins": self.joins, "leaves": self.leaves,
+                   "drains": self.drains}
+            if self.events:
+                out["last_event"] = tuple(self.events[-1])
+        return out
+
+    def _publish_gauges(self):
+        """membership.* / fleet.* gauges in the process metrics
+        registry (scraped on /metrics; docs/observability.md)."""
+        from ..observability import metrics
+        reg = metrics.registry
+        with self._lock:
+            reg.gauge("membership.epoch").set(self.epoch)
+            reg.gauge("fleet.size").set(len(self.members))
+
+    # -- placement policy (stateless, shared) ------------------------------
+
+    @staticmethod
+    def lowest_free_rank(world, held):
+        """The lowest shard rank in ``range(world)`` not in ``held``,
+        or None when every rank is taken (the joiner replicates a
+        full shard set instead of extending it).  This is the
+        server's ZeRO rank-assignment rule for joiners: ranks vacated
+        by leavers are refilled first, so shard coverage heals before
+        it grows."""
+        taken = set(held)
+        for rank in range(world):
+            if rank not in taken:
+                return rank
+        return None
+
+    @staticmethod
+    def pick_affine(candidates, worker, affinity_of, age_of):
+        """Affinity-first placement over ``candidates``:
+
+        1. a candidate whose affinity is ``worker`` — the one served
+           longest ago (its synced base already lives there: the job
+           ships as a delta, not a full ship);
+        2. else a fresh candidate (no affinity yet) — first in order;
+        3. else steal the stalest candidate overall (its old worker
+           is busy or gone; locality lost, progress preserved).
+
+        Returns None when ``candidates`` is empty.
+        """
+        candidates = list(candidates)
+        if not candidates:
+            return None
+        affine = [c for c in candidates if affinity_of(c) == worker]
+        if affine:
+            return min(affine, key=age_of)
+        fresh = [c for c in candidates if affinity_of(c) is None]
+        if fresh:
+            return fresh[0]
+        return min(candidates, key=age_of)
+
+    @staticmethod
+    def least_loaded(items, load_of):
+        """The item with the smallest load (ties: first in order) —
+        the launcher's respawn/replica placement rule.  Returns None
+        when ``items`` is empty."""
+        items = list(items)
+        if not items:
+            return None
+        return min(items, key=load_of)
+
+    def __repr__(self):
+        return "FleetScheduler(epoch=%d, size=%d)" % (
+            self.epoch, len(self.members))

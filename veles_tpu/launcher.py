@@ -314,7 +314,10 @@ class Launcher(Logger):
     def _node_of(self, desc):
         """Node for a dropped worker's respawn: the one with the
         fewest live worker processes — a died worker's ssh/subprocess
-        has exited, so its node shows the capacity gap."""
+        has exited, so its node shows the capacity gap.  The pick
+        itself is the fleet-wide least-loaded policy
+        (:meth:`FleetScheduler.least_loaded`), shared with every
+        other placement decision."""
         if not self.nodes:
             return "local"
         alive = {node: 0 for node in self.nodes}
@@ -323,7 +326,9 @@ class Launcher(Logger):
         for node, proc in procs:
             if proc.poll() is None and node in alive:
                 alive[node] += 1
-        return min(self.nodes, key=lambda n: alive[n])
+        from .fleet import FleetScheduler
+        return FleetScheduler.least_loaded(self.nodes,
+                                           lambda n: alive[n])
 
     def run(self):
         """Runs the workflow to completion (blocking)
@@ -353,6 +358,14 @@ class Launcher(Logger):
                     # results file.
                     raise self.server.failure
             elif self.client is not None:
+                # Spot-preemption contract (docs/distributed.md,
+                # "Elastic operations"): SIGTERM drains the worker —
+                # in-flight job finishes, update ships, bye goes out,
+                # exit code 0 — instead of killing it mid-recv.  The
+                # serving engine has had this since its drain PR; the
+                # training worker gets the same treatment here.
+                from .client import install_sigterm_drain
+                install_sigterm_drain(self.client)
                 self.client.run()
             else:
                 self.workflow.run()
@@ -516,6 +529,19 @@ class Launcher(Logger):
             population = None
         if population:
             payload["population"] = population
+        # Fleet row: membership epoch, live size, and the
+        # join/leave/drain tallies from any live fleet scheduler in
+        # this process — membership change is a numbered event an
+        # operator can see, not something to reconstruct from worker
+        # logs (docs/distributed.md, "Elastic operations").
+        try:
+            from .fleet import live_fleet_summary
+            fleet = live_fleet_summary()
+        except Exception as e:
+            self.debug("fleet heartbeat section unavailable: %s", e)
+            fleet = None
+        if fleet:
+            payload["fleet"] = fleet
         # Dashboard depth (reference: web_status.py:113-243 shows the
         # Graphviz workflow graph and plot links): the DOT text rides
         # the first beat and a ~per-minute refresh (the dashboard

@@ -646,11 +646,14 @@ def _seq_axis_fits(workflow, n_seq):
 def _rebuild_styled_mesh(workflow, surviving_devices, n, style):
     """Re-forms the workflow's non-DP layout over the survivors when
     divisibility allows; returns the new mesh or None (→ dp
-    fallback).  Every style preserves the OLD data-axis size first
-    (so the model/seq/expert/stage axis — which layer geometry was
-    validated against — shrinks as little as possible), then tries
-    data=2; the non-data axis must keep >= 2 devices or the style is
-    meaningless.
+    fallback).  On a shrink, every style preserves the OLD data-axis
+    size first (so the model/seq/expert/stage axis — which layer
+    geometry was validated against — shrinks as little as possible),
+    then tries data=2; the non-data axis must keep >= 2 devices or
+    the style is meaningless.  On GROWTH the preference inverts: the
+    non-data axis keeps its exact old size and the data axis widens.
+    A 3-axis style that no longer divides falls to a 2-axis partial
+    fit (keep tp, then keep sp) before the DP cliff.
 
     Host-syncing sharded params during the re-place gathers across
     the OLD device set — fine while the runtime still serves reads,
@@ -660,9 +663,23 @@ def _rebuild_styled_mesh(workflow, surviving_devices, n, style):
         name, data_axis, other_axis = style
         old_data = (old_mesh.shape.get(data_axis)
                     if old_mesh is not None else None)
-        for candidate in (old_data, 2):
-            if candidate and n % candidate == 0 and \
-                    n // candidate >= 2:
+        old_other = (old_mesh.shape.get(other_axis)
+                     if old_mesh is not None else None)
+        candidates = [old_data, 2]
+        if old_data and old_other and n > old_data * old_other \
+                and n % old_other == 0:
+            # GROWTH: joiners widen the data axis while the non-data
+            # axis keeps its exact old size — layer geometry was
+            # validated against that size, and the new capacity
+            # belongs to batch throughput, not to an unvalidated
+            # re-split of the model/seq/expert/stage plane.
+            candidates.insert(0, n // old_other)
+        seen = set()
+        for candidate in candidates:
+            if not candidate or candidate in seen:
+                continue
+            seen.add(candidate)
+            if n % candidate == 0 and n // candidate >= 2:
                 if name == "dp_sp" and \
                         not _seq_axis_fits(workflow, n // candidate):
                     continue
@@ -678,36 +695,70 @@ def _rebuild_styled_mesh(workflow, surviving_devices, n, style):
                 return mesh
         return None
     if style[0] == "dp_tp_sp" and len(style) == 4:
-        # Preserve the model and seq sizes exactly (both were
-        # validated against layer geometry / sequence length); only
-        # the data axis absorbs the loss.
+        # Exact fit first: model and seq sizes preserved (both were
+        # validated against layer geometry / sequence length), the
+        # data axis alone absorbing the change.
         _, data_axis, model_axis, seq_axis = style
         if old_mesh is None:
             return None
         m = old_mesh.shape.get(model_axis)
         s = old_mesh.shape.get(seq_axis)
-        if not m or not s or n % (m * s) or n // (m * s) < 1 or \
-                not _seq_axis_fits(workflow, s):
+        if not m or not s:
             return None
-        mesh = make_mesh(surviving_devices,
-                         {data_axis: n // (m * s),
-                          model_axis: m, seq_axis: s})
-        apply_dp_tp_sp_sharding(workflow, mesh, data_axis=data_axis,
-                                model_axis=model_axis,
-                                seq_axis=seq_axis)
-        return mesh
+        if n % (m * s) == 0 and n // (m * s) >= 1 and \
+                _seq_axis_fits(workflow, s):
+            mesh = make_mesh(surviving_devices,
+                             {data_axis: n // (m * s),
+                              model_axis: m, seq_axis: s})
+            apply_dp_tp_sp_sharding(workflow, mesh,
+                                    data_axis=data_axis,
+                                    model_axis=model_axis,
+                                    seq_axis=seq_axis)
+            return mesh
+        # Partial fit: the survivors cannot hold the exact m×s plane
+        # — shrink ONE axis at a time before the DP cliff wipes both.
+        # Keep the tensor axis (drop sequence parallelism) first:
+        # tp shards weights, so losing it costs per-chip memory,
+        # while losing sp only costs long-sequence activation
+        # headroom.  Then keep the seq axis (drop tp).  The applier
+        # records the surviving 2-axis style, so later rebuilds walk
+        # from what actually survived.
+        if m >= 2 and n % m == 0 and n // m >= 1:
+            mesh = make_mesh(surviving_devices,
+                             {data_axis: n // m, model_axis: m})
+            apply_dp_tp_sharding(workflow, mesh,
+                                 data_axis=data_axis,
+                                 model_axis=model_axis)
+            return mesh
+        if s >= 2 and n % s == 0 and n // s >= 1 and \
+                _seq_axis_fits(workflow, s):
+            mesh = make_mesh(surviving_devices,
+                             {data_axis: n // s, seq_axis: s})
+            apply_dp_sp_sharding(workflow, mesh,
+                                 data_axis=data_axis,
+                                 seq_axis=seq_axis)
+            return mesh
+        return None
     return None
 
 
 def rebuild_mesh(workflow, surviving_devices=None, axis="data",
-                 requeue_in_flight=True):
-    """Elastic recovery after chip loss (the mesh-granularity
-    equivalent of the reference's drop_slave+requeue,
-    server.py:315-338): re-form the mesh over the surviving devices,
+                 requeue_in_flight=True, epoch=None):
+    """Elastic membership change at mesh granularity — SHRINK (the
+    drop_slave+requeue equivalent of the reference's server.py:315-338)
+    and GROWTH alike: re-form the mesh over the new device set,
     re-place every step tensor (the Vector sharding setter host-syncs
     and frees old buffers when its sharding changes), requeue
     whatever the loader had in flight — the whole block in block
     mode — and force the step to recompile for the new topology.
+
+    ``epoch`` stamps the workflow with the caller's membership epoch
+    (the server's ``FleetScheduler`` epoch for a fleet-driven
+    rebuild); without one a local monotonic count advances, so every
+    rebuild is a numbered event either way.  The stamp is published
+    as the ``membership.epoch`` gauge and counted under
+    ``membership.rebuilds`` / ``membership.grow`` /
+    ``membership.shrink``.
 
     ``requeue_in_flight`` gives AT-LEAST-ONCE semantics: without a
     commit marker there is no telling whether the interrupted
@@ -730,6 +781,8 @@ def rebuild_mesh(workflow, surviving_devices=None, axis="data",
     if surviving_devices is None:
         surviving_devices = jax.devices()
     n = len(surviving_devices)
+    prior = getattr(workflow, "mesh", None)
+    old_n = int(prior.devices.size) if prior is not None else None
     style = getattr(workflow, "_parallel_style_", None) or \
         ("dp", axis)
     # Recovery context: every re-placement must round-trip through
@@ -771,4 +824,19 @@ def rebuild_mesh(workflow, surviving_devices=None, axis="data",
         invalidate = getattr(loader, "invalidate_staged", None)
         if invalidate is not None:
             invalidate()
+    # Membership-epoch stamp: this rebuild is a numbered event.  The
+    # gauge is what the heartbeat "fleet" row, web_status, and
+    # /metrics surface; the counters say which direction the fleet
+    # walked.
+    from .. import resilience
+    from ..observability import metrics
+    workflow._membership_epoch_ = int(epoch) if epoch is not None \
+        else getattr(workflow, "_membership_epoch_", 0) + 1
+    resilience.stats.incr("membership.rebuilds")
+    if old_n is not None and n > old_n:
+        resilience.stats.incr("membership.grow")
+    elif old_n is not None and n < old_n:
+        resilience.stats.incr("membership.shrink")
+    metrics.registry.gauge("membership.epoch").set(
+        workflow._membership_epoch_)
     return mesh

@@ -333,9 +333,12 @@ class PopulationMaster(Workflow):
     def _pick_member_locked(self, slave):
         """One member, one job in flight: folds stay serialized per
         lineage (the delta fold then reconstructs the worker's exact
-        values).  Affinity first — a member stays on the worker that
-        holds its synced base, so steady state ships deltas, not full
-        weights."""
+        values).  The pick itself is the fleet-wide affinity policy
+        (:meth:`FleetScheduler.pick_affine`): affinity first — a
+        member stays on the worker that holds its synced base, so
+        steady state ships deltas, not full weights — then a fresh
+        member, then steal the least recently served (its next job to
+        this worker is a one-time full ship, then deltas again)."""
         if self.mode == "ga":
             self._refill_ga_locked()
         candidates = [m for m in self.members
@@ -344,17 +347,11 @@ class PopulationMaster(Workflow):
         if self.mode == "ga":
             live = set(self._ga_live.values())
             candidates = [m for m in candidates if m in live]
-        if not candidates:
-            return None
-        affine = [m for m in candidates if m.affinity == slave]
-        if affine:
-            return min(affine, key=lambda m: m.last_served)
-        fresh = [m for m in candidates if m.affinity is None]
-        if fresh:
-            return fresh[0]
-        # Steal the least recently served member (its next job to
-        # this worker is a one-time full ship, then deltas again).
-        return min(candidates, key=lambda m: m.last_served)
+        from ..fleet import FleetScheduler
+        return FleetScheduler.pick_affine(
+            candidates, slave,
+            affinity_of=lambda m: m.affinity,
+            age_of=lambda m: m.last_served)
 
     def _refill_ga_locked(self):
         """Builds lineages for pending chromosomes of the current GA

@@ -31,6 +31,7 @@ point                     consulted by
 ``net.recv``              :class:`network_common.Channel` before a read
 ``net.connect``           :class:`client.Client` before dialing
 ``worker.job``            :class:`client.Client` before executing a job
+``fleet.join``            :class:`server.Server` while admitting a joiner
 ``snapshot.write``        :class:`snapshotter.SnapshotterToFile` per write
 ``master.crash``          :class:`server.Server` after serving/applying
 ========================  ================================================
@@ -46,6 +47,8 @@ Chaos-plan grammar (comma-separated entries)::
 Faults: ``net.drop`` (send dies), ``net.recv_drop`` (read dies),
 ``net.connect_fail`` (dial refused), ``worker.kill`` (worker process
 death), ``worker.hang`` (worker stalls — exercises the watchdog),
+``worker.preempt`` (planned preemption — the worker drains and says
+bye), ``fleet.join`` (a joiner's admission dies mid-handshake),
 ``snapshot.fail`` (checkpoint write error), ``master.crash``
 (coordinator process death).
 
@@ -110,6 +113,17 @@ class WorkerHang(InjectedFault):
                  seconds=3600.0):
         super(WorkerHang, self).__init__(fault, counter, count)
         self.seconds = seconds
+
+
+class WorkerPreempted(InjectedFault):
+    """Simulated spot/maintenance preemption notice.  Unlike
+    :class:`WorkerKilled` this is a PLANNED departure: the client
+    catches it, finishes the in-flight job, ships the update, sends
+    the ``bye`` frame, and leaves cleanly — the master records a
+    retirement (``server.goodbye``), not a drop.  Past the
+    ``--preempt-grace`` budget the drain degrades to an abrupt drop
+    (today's requeue path), which is what a real preemptor does when
+    the grace window closes."""
 
 
 class MasterCrash(InjectedFault):
@@ -332,6 +346,8 @@ FAULTS = {
     "net.connect_fail": ("net.connect", InjectedNetworkFault),
     "worker.kill": ("worker.job", WorkerKilled),
     "worker.hang": ("worker.job", WorkerHang),
+    "worker.preempt": ("worker.job", WorkerPreempted),
+    "fleet.join": ("fleet.join", InjectedNetworkFault),
     "snapshot.fail": ("snapshot.write", SnapshotWriteFault),
     "snapshot.corrupt": ("snapshot.corrupt", InjectedSnapshotCorruption),
     "step.nan": ("step.nan", InjectedStepNaN),

@@ -32,6 +32,7 @@ import time
 from . import resilience
 from .config import root, get as config_get
 from .distributable import SniffedLock
+from .fleet import FleetScheduler
 from .logger import Logger
 from .network_common import (Channel, machine_id, normalize_secret,
                              parse_address)
@@ -137,6 +138,11 @@ class SlaveDescription(object):
         #: Slot-shard rank this session owns (--net-zero sessions
         #: only) — consulted when assigning ranks to later joiners.
         self.zero_rank = None
+        #: Membership epoch at which this session was admitted
+        #: (FleetScheduler.join) — joins and leaves are numbered
+        #: events, so "which fleet did this worker belong to?" has a
+        #: stable answer in logs and heartbeats.
+        self.epoch = None
         #: Parole: this session belongs to a previously-blacklisted
         #: machine — it gets ONE job at a time until one completes
         #: clean (then the machine's blacklist entry is erased).
@@ -237,6 +243,19 @@ class Server(Logger):
             config_get(root.common.server.blacklist_cooldown, 60.0)))
         #: machine id -> wall time of its latest blacklisting.
         self._blacklist = {}  # guarded-by: _lock
+        #: Membership registry + shared placement policy: every join
+        #: and leave bumps an epoch-numbered event here, surfaced as
+        #: the ``membership.epoch`` gauge and the launcher-heartbeat
+        #: "fleet" section.  Injectable for tests / shared fleets.
+        self.fleet = kwargs.get("fleet") or FleetScheduler()
+        #: Optional global in-flight-job ceiling.  ``max_inflight=1``
+        #: serializes dispatch: every delta fold then lands on a
+        #: fully-current base, making the weight trajectory
+        #: bit-identical to a standalone run regardless of fleet size
+        #: or membership churn — the property the elastic-soak parity
+        #: gate asserts.  None (default) = unbounded, the normal
+        #: delayed-SGD regime.
+        self.max_inflight = kwargs.get("max_inflight")
         # Threads LAST, accept included: the socket is bound above,
         # so a worker hammering reconnects (the chaos restart loop)
         # can dial the instant the port exists — its handler must
@@ -435,6 +454,14 @@ class Server(Logger):
                 chan.send({"cmd": "error", "error": proto_error})
                 resilience.stats.incr("server.proto_reject")
                 return
+            # The admission seam: a ``fleet.join`` chaos rule kills
+            # the joiner here — after checksum/protocol vetting,
+            # before any registration — so tests can prove a join
+            # that dies mid-handshake leaves no membership residue
+            # (no epoch bump, no slave entry, no requeue).  The
+            # raised fault is a ConnectionError: the dead-peer path
+            # below handles it, and the worker redials.
+            self._injector_().check("fleet.join")
             with self._lock:
                 self._slave_seq += 1
                 sid = "%s/%d" % (hello.get("mid", machine_id()),
@@ -453,9 +480,9 @@ class Server(Logger):
                     held = {s.zero_rank for s in
                             self._slaves.values()
                             if s.zero_rank is not None}
-                    free = [r for r in range(k) if r not in held]
-                    proto["zero_rank"] = free[0] if free else \
-                        self._zero_seq % k
+                    rank = FleetScheduler.lowest_free_rank(k, held)
+                    proto["zero_rank"] = rank if rank is not None \
+                        else self._zero_seq % k
                     self._zero_seq += 1
                 desc = SlaveDescription(
                     sid, hello.get("mid"), hello.get("power", 1.0),
@@ -469,6 +496,8 @@ class Server(Logger):
                     # completes clean).
                     desc.probation = True
                 self._slaves[sid] = desc
+                desc.epoch = self.fleet.join(sid, desc.mid,
+                                             desc.power)
                 note = getattr(self.workflow, "note_slave_protocol",
                                None)
                 if note is not None:
@@ -487,7 +516,8 @@ class Server(Logger):
                        "proto": proto})
             chan.rekey(nonce)
             chan.set_proto(proto)
-            self.info("worker %s joined (power %.1f%s)", sid,
+            self.info("worker %s joined at membership epoch %d "
+                      "(power %.1f%s)", sid, desc.epoch,
                       desc.power,
                       ", proto: delta=%s codec=%s ticks=%s" % (
                           proto.get("delta"), proto.get("codec"),
@@ -721,6 +751,12 @@ class Server(Logger):
         with self._lock:
             if self._finished_locked():
                 return None
+            if self.max_inflight is not None and \
+                    sum(self._outstanding.values()) >= \
+                    self.max_inflight:
+                # Serialized dispatch (see __init__): hold this
+                # worker on no_job until an outstanding fold lands.
+                return None
             data = self.workflow.generate_data_for_slave(desc.id)
             if data is None:
                 # Workflow has nothing to hand out right now (e.g. a
@@ -801,10 +837,24 @@ class Server(Logger):
                 # — the job must be requeued like any other loss.
                 resilience.stats.incr("server.requeue")
                 clean = False
+            if clean and desc.probation:
+                # A probation session that drains and says bye with
+                # nothing outstanding counts as a clean completion
+                # for parole purposes — an orderly departure (spot
+                # preemption, scale-down) must not keep the machine's
+                # cooldown armed as if it had failed again.
+                desc.probation = False
+                if self._blacklist.pop(desc.mid, None) is not None:
+                    resilience.stats.incr("server.parole")
+                    self.info("worker %s said a clean goodbye during "
+                              "probation — parole granted", desc.id)
             self.workflow.drop_slave(desc.id)
+        self.fleet.leave(desc.id, clean=clean)
         if clean:
             resilience.stats.incr("server.goodbye")
-            self.info("worker %s retired (clean goodbye)", desc.id)
+            self.info("worker %s retired (clean goodbye) — "
+                      "membership epoch %d", desc.id,
+                      self.fleet.epoch)
             return
         resilience.stats.incr("server.drop")
         self.info("worker %s dropped", desc.id)

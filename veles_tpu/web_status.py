@@ -148,7 +148,7 @@ class WebStatusServer(JsonHttpServer):
     #: labeled Prometheus gauges on ``GET /metrics`` — ONE scrape
     #: endpoint covers every master this dashboard tracks.
     METRIC_SECTIONS = ("comms", "resilience", "perf", "serving",
-                      "population", "metrics")
+                      "population", "fleet", "metrics")
 
     def metrics_text(self):
         """Prometheus text exposition: this process's own registry
@@ -241,19 +241,28 @@ class WebStatusServer(JsonHttpServer):
                 esc(json.dumps(population, sort_keys=True))
                 if isinstance(population, dict) and population
                 else "")
+            # Fleet row: membership epoch, live size, and the
+            # join/leave/drain tallies from the elastic fleet's
+            # heartbeat section (docs/distributed.md).
+            fleet = info.get("fleet")
+            fleet_row = (
+                "<tr><th>fleet</th><td>%s</td></tr>" %
+                esc(json.dumps(fleet, sort_keys=True))
+                if isinstance(fleet, dict) and fleet else "")
             rows.append(
                 "<h2>%s <small>(%s)</small></h2>"
                 "<table><tr><th>mode</th><td>%s</td></tr>"
                 "<tr><th>epoch</th><td>%s</td></tr>"
                 "<tr><th>runtime</th><td>%.0f s</td></tr>"
-                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s%s%s%s"
+                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s%s%s%s%s"
                 "</table>" %
                 (esc(info.get("workflow", "?")), esc(mid),
                  esc(info.get("mode", "?")), esc(info.get("epoch", "?")),
                  runtime,
                  esc(json.dumps(info.get("metrics", {}))),
                  health_row, resilience_row, comms_row,
-                 serving_row, perf_row, population_row) +
+                 serving_row, perf_row, population_row,
+                 fleet_row) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
                  "</th><th>jobs</th><th>jobs/s</th></tr>%s</table>"
                  % wtable if workers else "") +
