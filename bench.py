@@ -48,6 +48,14 @@ reports tok/s per mode, accept rate, tokens/step, draft/verify
 latencies, and rewound blocks; ``vs_baseline`` is adaptive-spec over
 plain paged decode on the same workload.
 
+``python bench.py --serve --replicas N [--fabric-disagg]`` runs the
+serving-fabric soak (BENCH_r12): the same load through N paged
+replicas behind the prefix-affinity ``ReplicaRouter`` — aggregate
+tok/s vs a 1-replica fleet, per-replica occupancy, the
+cross-replica prefix hit-rate, and with ``--fabric-disagg`` the
+disaggregated-vs-colocated TTFT/ITL p50/p99 A/B (prefill worker
+ships KV blocks, decode replicas adopt).
+
 ``python bench.py --elastic`` runs the elastic-fleet control-plane
 bench (docs/distributed.md "Elastic operations"): a real loopback
 socket fleet walks 4→2→4 workers mid-run — two workers drain on a
@@ -98,7 +106,8 @@ BENCH_FLAGS = ("--mlp", "--lm", "--lm-toy", "--serve", "--streamed",
                "--trace-out", "--optimizer", "--pp-schedule",
                "--moe-topk", "--moe-experts", "--population",
                "--population-members", "--population-epochs",
-               "--population-ticks", "--elastic", "--elastic-jobs")
+               "--population-ticks", "--elastic", "--elastic-jobs",
+               "--replicas", "--fabric-disagg")
 
 # Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
 # or blocks gain <3% more.  The perf levers that got here: banded-
@@ -389,11 +398,20 @@ def serve_bench(argv):
     streams = SERVE_STREAMS
     seconds = SERVE_SECONDS
     spec_ab = "--spec" in argv
-    for arg in argv:
+    replicas = 1
+    disagg = "--fabric-disagg" in argv
+    for i, arg in enumerate(argv):
         if arg.startswith("--serve-streams="):
             streams = int(arg.split("=", 1)[1])
         elif arg.startswith("--serve-seconds="):
             seconds = float(arg.split("=", 1)[1])
+        elif arg.startswith("--replicas="):
+            replicas = int(arg.split("=", 1)[1])
+        elif arg == "--replicas" and i + 1 < len(argv):
+            replicas = int(argv[i + 1])
+    if replicas > 1 or disagg:
+        return serve_fabric_bench(streams, seconds, replicas,
+                                  disagg)
     path = os.path.join(tempfile.gettempdir(),
                         "veles_serve_bench.veles.tgz")
     build_serve_artifact(
@@ -479,6 +497,146 @@ def serve_bench(argv):
         "kv_cow_copies": occ.get("cow_copies"),
         "dense_tok_per_sec": round(dense_tps, 1),
     }))
+
+
+def serve_fabric_bench(streams, seconds, replicas, disagg):
+    """``--serve --replicas N [--fabric-disagg]`` (BENCH_r12): the
+    serving-fabric soak — N paged engine replicas behind the
+    prefix-affinity ``ReplicaRouter``, the same mixed-geometry
+    ≥64-stream load as the plain serve soak.  Reports aggregate
+    tok/s and its ratio to a 1-replica fleet (near-linear on real
+    accelerators; CPU loopback shares one host, see BENCHNOTES),
+    per-replica occupancy, the cross-replica prefix hit-rate the
+    affinity routing exists to protect, and — with
+    ``--fabric-disagg`` — the disaggregated-vs-colocated TTFT/ITL
+    A/B (prefill worker fills KV blocks, decode replicas adopt them
+    over the wire, so decode-side TTFT shrinks)."""
+    import tempfile
+    import numpy
+    from veles_tpu.export import ExportedModel
+    from veles_tpu.serving import (BucketPolicy, PrefillWorker,
+                                   ReplicaRouter, ServingEngine)
+    path = os.path.join(tempfile.gettempdir(),
+                        "veles_serve_bench.veles.tgz")
+    build_serve_artifact(path, scale=0.5)
+
+    # Sized to FIT (unlike the single-engine soak, which starves the
+    # pool on purpose): the fabric soak measures routing and
+    # adoption, and a shed request routes nowhere.
+    per_row = -(-(max(SERVE_PROMPT_CHOICES) +
+                  max(SERVE_NEW_CHOICES)) // SERVE_KV_BLOCK)
+    kv_blocks = SERVE_MAX_BATCH * per_row + 1
+
+    def build_engine(model):
+        return ServingEngine(
+            model, max_batch=SERVE_MAX_BATCH, queue_depth=streams,
+            default_deadline=max(30.0, seconds),
+            policy=BucketPolicy(max_batch=SERVE_MAX_BATCH,
+                                batch_floor=8,
+                                prompt_cap=SERVE_POS),
+            paged=True, kv_blocks=kv_blocks,
+            kv_block_size=SERVE_KV_BLOCK).start()
+
+    class FabricClient(object):
+        """run_serve_load's engine surface over the router (the
+        pool sampler reads ``kv_pool`` — per-replica pools are in
+        ``router.occupancy()`` instead)."""
+        kv_pool = None
+
+        def __init__(self, router):
+            self._router = router
+
+        def submit_generate(self, tokens, max_new, seed=0):
+            return self._router.submit_generate(tokens, max_new,
+                                                seed=seed)
+
+    def merged_pct(engines, key, p):
+        # Raw samples pooled ACROSS replicas, then one percentile —
+        # percentiles of per-replica percentiles are not percentiles.
+        samples = []
+        for e in engines:
+            samples.extend(e.stats.latency_samples(key))
+        if not samples:
+            return None
+        return round(
+            float(numpy.percentile(samples, p)) * 1000.0, 3)
+
+    def one_fleet(n, with_disagg):
+        model = ExportedModel(path, compile_capacity=256)
+        engines = [build_engine(model) for _ in range(n)]
+        prefill = PrefillWorker(build_engine(model)) \
+            if with_disagg else None
+        router = ReplicaRouter(prefill=prefill)
+        for i, engine in enumerate(engines):
+            router.add_replica("r%d" % i, engine)
+        # Replicas share the model object, hence ONE compile cache:
+        # warming the first engine warms the fleet.
+        engines[0].warmup(
+            longest_prompt=max(SERVE_PROMPT_CHOICES),
+            max_new=max(SERVE_NEW_CHOICES))
+        try:
+            totals = run_serve_load(FabricClient(router), streams,
+                                    seconds)
+            occ = router.occupancy()
+            lat = {"ttft_p50_ms": merged_pct(engines,
+                                             "ttft.generate", 50),
+                   "ttft_p99_ms": merged_pct(engines,
+                                             "ttft.generate", 99),
+                   "itl_p50_ms": merged_pct(engines,
+                                            "itl.decode", 50),
+                   "itl_p99_ms": merged_pct(engines,
+                                            "itl.decode", 99)}
+        finally:
+            router.stop(drain=False)
+        return totals, occ, lat
+
+    single_totals, _, _ = one_fleet(1, False)
+    single_tps = single_totals["tokens"] / \
+        max(single_totals["wall"], 1e-9)
+    fleet_totals, fleet_occ, fleet_lat = one_fleet(replicas, False)
+    fleet_tps = fleet_totals["tokens"] / \
+        max(fleet_totals["wall"], 1e-9)
+    out = {
+        "metric": "serve_fabric_tok_per_sec",
+        "value": round(fleet_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(fleet_tps / max(single_tps, 1e-9), 4),
+        "vs_baseline_meaning":
+            "fabric_%d_replicas_vs_single_replica_tok_per_sec"
+            % replicas,
+        "replicas": replicas,
+        "streams": streams,
+        "seconds": seconds,
+        "requests": fleet_totals["requests"],
+        "shed_429": fleet_totals["shed"],
+        "timeouts": fleet_totals["timeouts"],
+        "errors": fleet_totals["errors"],
+        "routed": fleet_occ["routed"],
+        "reroutes": fleet_occ["reroutes"],
+        "prefix_hit_rate": fleet_occ.get("prefix_hit_rate"),
+        "per_replica": fleet_occ["per_replica"],
+        "single_tok_per_sec": round(single_tps, 1),
+    }
+    out.update(fleet_lat)
+    if disagg:
+        d_totals, d_occ, d_lat = one_fleet(replicas, True)
+        d_tps = d_totals["tokens"] / max(d_totals["wall"], 1e-9)
+        speedup = None
+        if d_lat["ttft_p99_ms"] and fleet_lat["ttft_p99_ms"]:
+            speedup = round(fleet_lat["ttft_p99_ms"] /
+                            d_lat["ttft_p99_ms"], 4)
+        out["disagg"] = {
+            "tok_per_sec": round(d_tps, 1),
+            "adopted_blocks": d_occ["adopted_blocks"],
+            "prefix_hit_rate": d_occ.get("prefix_hit_rate"),
+            "ttft_p50_ms": d_lat["ttft_p50_ms"],
+            "ttft_p99_ms": d_lat["ttft_p99_ms"],
+            "itl_p50_ms": d_lat["itl_p50_ms"],
+            "itl_p99_ms": d_lat["itl_p99_ms"],
+            "colocated_ttft_p99_ms": fleet_lat["ttft_p99_ms"],
+            "ttft_p99_speedup": speedup,
+        }
+    print(json.dumps(out))
 
 
 def serve_spec_ab(one_mode, streams, seconds):

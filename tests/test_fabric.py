@@ -1,0 +1,567 @@
+"""Serving fabric (veles_tpu/serving/fabric/): replica router with
+prefix-affinity, prefill/decode disaggregation, multi-tenant quotas.
+
+The contracts under test, per docs/serving.md "Serving fabric":
+
+* `KVBlockPool.export_prefix_blocks`/`adopt_prefix_blocks` are
+  refcount-correct standalone (fabric bugs must not masquerade as
+  pool bugs), and the disagg wire payload round-trips through the
+  zero-copy framing with malformed input rejected, never crashed on;
+* consistent hashing is stable: draining one replica remaps ONLY the
+  keys it owned — surviving replicas keep their key ranges (and
+  therefore their warm prefix caches);
+* same-prefix requests land on the same replica and hit its prefix
+  cache (hit counter asserted) — the cross-replica prefix-cache
+  contract;
+* a draining replica's in-flight streams finish while new work
+  re-routes (drain-without-drop), and replica add/drain bumps fleet
+  membership epochs;
+* tenant-quota 429s carry Retry-After and never shed a sibling
+  tenant; unknown tenants get 403 once tenancy is configured;
+* responses through a 2-replica fabric are TOKEN-IDENTICAL to a
+  single engine (greedy, same artifact) — on a real artifact, and
+  with disaggregated prefill adoption in the loop;
+* the fabric heartbeat section has a web_status dashboard row.
+"""
+
+import threading
+import time
+
+import numpy
+import pytest
+
+from veles_tpu.error import Bug
+from veles_tpu.export import ExportedModel, KVBlockPool
+from veles_tpu.fleet import FleetScheduler
+from veles_tpu.serving import (ModelRegistry, PrefillWorker,
+                               RateLimited, ReplicaRouter,
+                               ServiceUnavailable, ServingEngine,
+                               TenantUnknown, live_fabric_summary,
+                               parse_tenant_spec)
+from veles_tpu.serving.fabric import (pack_kv_payload,
+                                      unpack_kv_payload)
+
+from test_serving import (FakeModel, PagedFakeModel, _get, _post,
+                          _random_lm_artifact)
+
+
+# -- helpers ---------------------------------------------------------------
+
+
+class FabricFakeModel(PagedFakeModel):
+    """PagedFakeModel + the export/import surface the disaggregation
+    leg needs: block payloads are synthesized from the block ids (no
+    device storage on the fake), so shape plumbing and refcounts are
+    exercised without XLA."""
+
+    manifest = {
+        "workflow": "FabricFake",
+        "units": [],
+        "input": {"sample_shape": [4], "dtype": "float32"},
+        "output": {"sample_shape": [3]},
+    }
+
+    def __init__(self, layers=2, heads=2, head_dim=2, **kwargs):
+        super(FabricFakeModel, self).__init__(**kwargs)
+        self.geometry = (layers, heads, head_dim)
+        self.imported = []  # (pool, ids, blocks.shape)
+
+    def export_kv_blocks(self, pool, ids):
+        L, H, D = self.geometry
+        n, bs = len(ids), pool.block_size
+        out = numpy.zeros((L, 2, n, bs, H, D), numpy.float32)
+        for j, b in enumerate(ids):
+            out[:, :, j] = float(b)
+        return out
+
+    def import_kv_blocks(self, pool, ids, blocks):
+        with self._lock:
+            self.imported.append((pool, list(ids),
+                                  numpy.asarray(blocks).shape))
+
+
+def _paged_engine(model=None, **kwargs):
+    kwargs.setdefault("max_batch", 4)
+    kwargs.setdefault("kv_blocks", 65)
+    kwargs.setdefault("kv_block_size", 8)
+    return ServingEngine(model or FabricFakeModel(), **kwargs)
+
+
+def _expected_fingerprint(prompt_row, max_new):
+    return (int(prompt_row[-1]) + 1 + numpy.arange(max_new)) % 97
+
+
+def _prompt_for_replica(router, name, length=16, seed_base=0):
+    """A prompt whose routing key lands on replica ``name``."""
+    for seed in range(seed_base, seed_base + 512):
+        prompt = (numpy.arange(length, dtype=numpy.int32)
+                  + seed * 7) % 89 + 1
+        if router.pick_replica(prompt).name == name:
+            return prompt
+    raise AssertionError("no prompt routes to %r" % name)
+
+
+# -- pool export/adopt (satellite: standalone, no fabric) ------------------
+
+
+def test_pool_export_adopt_refcount_correct():
+    src = KVBlockPool(10, 4)
+    tokens = numpy.arange(12, dtype=numpy.int32)
+    ids = src.alloc(3)
+    src.register_prefix(tokens, ids)
+    src.release(ids)  # prefix entries are now the only owners
+
+    n, got = src.export_prefix_blocks(tokens)
+    assert n == 3 and got == ids
+    # Export pinned the blocks for the caller: one extra ref each.
+    assert src.refs_of(got[0]) == 4
+    src.release(got)
+    assert src.refs_of(got[0]) == 3
+
+    dst = KVBlockPool(10, 4)
+    writes = []
+    out = dst.adopt_prefix_blocks(tokens, 3, write_fn=writes.append)
+    assert out is not None and len(out) == 3
+    assert writes == [out]
+    # Refcount-correct adoption: block j held by chain entries
+    # j+1..n and NOTHING else — identical to a local prefill's
+    # register_prefix.
+    for j, b in enumerate(out):
+        assert dst.refs_of(b) == 3 - j
+    # Idempotent: re-adoption returns the cached ids, writes nothing.
+    assert dst.adopt_prefix_blocks(tokens, 3) == out
+    assert len(writes) == 1
+    # A local request adopts the imported blocks as a prefix hit.
+    hit_n, hit_ids = dst.lookup_prefix(tokens)
+    assert (hit_n, hit_ids) == (3, out)
+    dst.release(hit_ids)
+    # Dropping the cache returns every block: no refcount residue.
+    assert dst.drop_prefixes() == 3
+    assert dst.free_count() == dst.usable
+
+
+def test_pool_adopt_failure_paths():
+    tokens = numpy.arange(8, dtype=numpy.int32)
+    # Exhaustion: a 3-block pool (2 usable) cannot adopt 2 blocks
+    # while another owner holds them -> None, nothing leaked.
+    pool = KVBlockPool(3, 4)
+    held = pool.alloc(2)
+    assert pool.adopt_prefix_blocks(tokens, 2) is None
+    pool.release(held)
+    assert pool.free_count() == pool.usable
+    # A write_fn failure releases the fresh blocks and re-raises.
+    pool2 = KVBlockPool(10, 4)
+
+    def boom(ids):
+        raise RuntimeError("device fell over")
+
+    with pytest.raises(RuntimeError):
+        pool2.adopt_prefix_blocks(tokens, 2, write_fn=boom)
+    assert pool2.free_count() == pool2.usable
+
+
+def test_kv_wire_roundtrip_and_rejects():
+    blocks = numpy.random.RandomState(0).rand(
+        2, 2, 3, 4, 2, 2).astype(numpy.float32)
+    tokens = numpy.arange(12, dtype=numpy.int32)
+    payload = pack_kv_payload(tokens, 3, blocks, 4, 7)
+    obj = unpack_kv_payload(payload)
+    assert obj is not None
+    assert obj["n_blocks"] == 3 and obj["block_size"] == 4
+    assert obj["weight_version"] == 7
+    assert numpy.array_equal(obj["tokens"], tokens)
+    assert numpy.array_equal(obj["blocks"], blocks)
+    # Malformed input reads as a dead peer (None), never a crash.
+    assert unpack_kv_payload(b"") is None
+    assert unpack_kv_payload(b"garbage bytes") is None
+    assert unpack_kv_payload(payload[:40]) is None
+
+
+# -- ring / routing --------------------------------------------------------
+
+
+def test_ring_remaps_only_the_drained_replicas_keys():
+    engines = {n: _paged_engine() for n in ("a", "b", "c")}
+    router = ReplicaRouter(fleet=FleetScheduler())
+    for name, engine in engines.items():
+        router.add_replica(name, engine)
+    prompts = [(numpy.arange(16, dtype=numpy.int32) + i) % 89
+               for i in range(64)]
+    before = [router.pick_replica(p).name for p in prompts]
+    with router._lock:
+        handle = router._replicas.pop("b")
+        router._rebuild_ring_locked()
+    after = [router.pick_replica(p).name for p in prompts]
+    moved = stayed = 0
+    for old, new in zip(before, after):
+        if old == "b":
+            moved += 1
+            assert new in ("a", "c")
+        else:
+            # Consistent hashing: keys owned by a SURVIVING replica
+            # keep their placement (their prefix caches stay warm).
+            assert new == old
+            stayed += 1
+    assert moved and stayed
+    with router._lock:
+        router._replicas["b"] = handle
+        router._rebuild_ring_locked()
+    assert [router.pick_replica(p).name for p in prompts] == before
+    assert sorted(set(before)) == ["a", "b", "c"], \
+        "64 keys over 3 replicas should touch all of them"
+
+
+def test_prefix_affinity_same_replica_hits_cache():
+    """Satellite (i): same-prefix requests land on the same replica
+    and hit ITS prefix cache — the hit counter is asserted."""
+    engines = {n: _paged_engine().start() for n in ("a", "b")}
+    router = ReplicaRouter(fleet=FleetScheduler())
+    for name, engine in engines.items():
+        router.add_replica(name, engine)
+    try:
+        prompt = _prompt_for_replica(router, "a")
+        home = engines["a"]
+        for i in range(3):
+            out = router.submit_generate(prompt, 4)
+            assert numpy.array_equal(
+                out[0, len(prompt):],
+                _expected_fingerprint(prompt, 4))
+        occ = home.kv_pool.occupancy()
+        # Request 1 prefills (a miss), requests 2 and 3 adopt the
+        # cached full-block prefix.
+        assert occ["prefix_hits"] >= 2, occ
+        other = engines["b"].kv_pool
+        assert other is None or \
+            other.occupancy()["prefix_hits"] == 0
+        snap = router.occupancy()
+        assert snap["routed"] == 3
+        assert snap["prefix_hits"] >= 2
+        assert snap["prefix_hit_rate"] > 0
+    finally:
+        router.stop(drain=False)
+
+
+def test_drain_without_drop_reroutes_new_work():
+    """Satellite (ii): a draining replica's in-flight streams finish
+    while new work re-routes to the survivors."""
+    engines = {n: _paged_engine(
+        FabricFakeModel(step_delay=0.03)).start()
+        for n in ("a", "b")}
+    fleet = FleetScheduler()
+    router = ReplicaRouter(fleet=fleet)
+    for name, engine in engines.items():
+        router.add_replica(name, engine)
+    assert fleet.epoch == 2  # two joins, numbered
+    try:
+        prompt_a = _prompt_for_replica(router, "a")
+        done = {}
+
+        def long_stream():
+            done["out"] = router.submit_generate(prompt_a, 24)
+
+        t = threading.Thread(target=long_stream)
+        t.start()
+        # Wait until the stream is live on replica a.
+        deadline = time.monotonic() + 5.0
+        while engines["a"].queue_depth_now() == 0 and \
+                not engines["a"]._rows and \
+                time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        drained = {}
+
+        def drain():
+            router.drain_replica("a", timeout=30.0)
+            drained["at"] = time.monotonic()
+
+        dt = threading.Thread(target=drain)
+        dt.start()
+        # New work arriving DURING the drain routes to the survivor —
+        # including keys that previously belonged to a.
+        time.sleep(0.05)
+        out = router.submit_generate(prompt_a, 3)
+        assert numpy.array_equal(
+            out[0, len(prompt_a):],
+            _expected_fingerprint(prompt_a, 3))
+        assert router.pick_replica(prompt_a).name == "b"
+        t.join(timeout=30)
+        dt.join(timeout=30)
+        assert not t.is_alive() and not dt.is_alive()
+        # The in-flight stream FINISHED with correct tokens — a
+        # drain is never a drop.
+        assert numpy.array_equal(
+            done["out"][0, len(prompt_a):],
+            _expected_fingerprint(prompt_a, 24))
+        snap = fleet.snapshot()
+        assert snap["drains"] == 1 and snap["epoch"] == 3
+        assert router.replica_names() == ["b"]
+    finally:
+        router.stop(drain=False)
+
+
+def test_router_503_when_no_replica_up():
+    router = ReplicaRouter(fleet=FleetScheduler())
+    with pytest.raises(ServiceUnavailable):
+        router.submit_generate(numpy.arange(4), 2)
+    with pytest.raises(ServiceUnavailable):
+        router.submit_classify(numpy.zeros((1, 4)))
+
+
+def test_scale_hint_follows_queue_depth():
+    class StubEngine(object):
+        def __init__(self):
+            self.depth = 0
+
+        def queue_depth_now(self):
+            return self.depth
+
+    router = ReplicaRouter(fleet=FleetScheduler(), target_depth=4)
+    stubs = [StubEngine(), StubEngine()]
+    router.add_replica("s0", stubs[0])
+    router.add_replica("s1", stubs[1])
+    assert router.scale_hint() == -1  # idle 2-replica fleet shrinks
+    stubs[0].depth = stubs[1].depth = 2
+    assert router.scale_hint() == 0
+    stubs[0].depth = stubs[1].depth = 9
+    assert router.scale_hint() == 1  # overloaded fleet grows
+
+
+# -- tenants ---------------------------------------------------------------
+
+
+def test_parse_tenant_spec_grammar():
+    assert parse_tenant_spec("a=5") == ("a", 5.0, None, None)
+    assert parse_tenant_spec("a=5:10") == ("a", 5.0, 10.0, None)
+    assert parse_tenant_spec("a=5:10@m.tgz") == \
+        ("a", 5.0, 10.0, "m.tgz")
+    assert parse_tenant_spec("a=0.5@m.tgz") == \
+        ("a", 0.5, None, "m.tgz")
+    with pytest.raises(ValueError):
+        parse_tenant_spec("no-rate")
+    with pytest.raises(ValueError):
+        parse_tenant_spec("=5")
+
+
+def test_tenant_quota_isolation_and_403():
+    clock = [0.0]
+    registry = ModelRegistry(clock=lambda: clock[0])
+    registry.register("flooder", rate=1.0, burst=2.0)
+    registry.register("sibling", rate=1.0, burst=2.0,
+                      artifact="sib.veles.tgz")
+    # Unknown tenant: 403, not 429 — retrying cannot help.
+    with pytest.raises(TenantUnknown) as e:
+        registry.admit("mallory")
+    assert e.value.status == 403
+    # The flooder drains its own bucket...
+    registry.admit("flooder")
+    registry.admit("flooder")
+    with pytest.raises(RateLimited) as e:
+        registry.admit("flooder")
+    assert e.value.status == 429 and e.value.retry_after > 0
+    # ...and the sibling is untouched: its bucket is its own.
+    registry.admit("sibling")
+    registry.admit("sibling")
+    with pytest.raises(RateLimited):
+        registry.admit("sibling")
+    assert registry.artifact_for("sibling") == "sib.veles.tgz"
+    snap = registry.snapshot()
+    assert snap["tenants"]["flooder"]["admitted"] == 2
+    assert snap["tenants"]["flooder"]["rejected"] == 1
+    assert snap["tenants"]["sibling"]["admitted"] == 2
+    # Refill restores the flooder without operator action.
+    clock[0] = 10.0
+    registry.admit("flooder")
+
+
+def test_tenant_quota_429_over_http_with_retry_after():
+    """Satellite (iii) over the real HTTP path: tenant-quota 429s
+    carry Retry-After and never shed a sibling tenant."""
+    from veles_tpu.restful import ModelServer
+    server = ModelServer(FakeModel(), host="127.0.0.1", port=0,
+                         tenant=["flooder=0.001:2", "sibling=100"]
+                         ).start()
+    try:
+        assert server.fabric is not None
+        payload = {"tokens": [[1, 2, 3]], "max_new_tokens": 2}
+        statuses = []
+        retry_after = None
+        for _ in range(4):
+            status, _body, headers = _post(
+                server.port, "/api/generate", payload,
+                headers={"X-Tenant": "flooder"})
+            statuses.append(status)
+            if status == 429:
+                retry_after = headers.get("Retry-After")
+        assert statuses.count(200) == 2, statuses
+        assert statuses.count(429) == 2, statuses
+        assert retry_after is not None and int(retry_after) >= 1
+        # The sibling rides through the flood untouched.
+        for _ in range(4):
+            status, body, _ = _post(
+                server.port, "/api/generate", payload,
+                headers={"X-Tenant": "sibling"})
+            assert status == 200
+        # Tenant in the JSON body works too (no header).
+        status, _, _ = _post(server.port, "/api/generate",
+                             dict(payload, tenant="sibling"))
+        assert status == 200
+        # Unknown tenant: 403 once tenancy is configured.
+        status, _, _ = _post(server.port, "/api/generate", payload,
+                             headers={"X-Tenant": "mallory"})
+        assert status == 403
+        status, _, _ = _post(server.port, "/api/generate", payload)
+        assert status == 403  # anonymous, no "default" registered
+        # /stats carries the fabric section with the tenant table.
+        status, stats = _get(server.port, "/stats")
+        assert status == 200
+        tenants = stats["fabric"]["registry"]["tenants"]
+        assert tenants["flooder"]["rejected"] >= 2
+        assert tenants["sibling"]["rejected"] == 0
+    finally:
+        server.stop()
+
+
+# -- disaggregation --------------------------------------------------------
+
+
+def test_disagg_adoption_on_fake_engine():
+    """The adoption op rides the device-thread op queue: imported
+    blocks register in the decode pool's prefix cache and the next
+    local request hits them."""
+    model = FabricFakeModel()
+    engine = _paged_engine(model).start()
+    try:
+        prompt = numpy.arange(24, dtype=numpy.int32) + 1
+        bs = 8
+        L, H, D = model.geometry
+        blocks = numpy.zeros((L, 2, 2, bs, H, D), numpy.float32)
+        payload = unpack_kv_payload(pack_kv_payload(
+            prompt[:16], 2, blocks, bs, engine.weight_version))
+        assert payload is not None
+        adopted = engine.adopt_kv_prefix(prompt[:16], payload)
+        assert adopted == 2
+        assert model.imported and model.imported[0][1]
+        assert engine.stats.get("kv.adopt") == 1
+        # Version skew refuses adoption (stale KV must never serve).
+        stale = dict(payload, weight_version=99)
+        assert engine.adopt_kv_prefix(prompt[:16], stale) == 0
+        assert engine.stats.get("kv.adopt_stale") == 1
+        # The next generate adopts the imported prefix: a pool HIT,
+        # and the output fingerprint is unchanged.
+        out = engine.submit_generate(prompt, 4)
+        assert numpy.array_equal(out[0, len(prompt):],
+                                 _expected_fingerprint(prompt, 4))
+        assert engine.kv_pool.occupancy()["prefix_hits"] >= 1
+    finally:
+        engine.stop()
+
+
+def test_prefill_worker_requires_paged_engine():
+    with pytest.raises(Bug):
+        PrefillWorker(ServingEngine(FakeModel(), paged=False))
+
+
+# -- real-artifact gates ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lm_artifact(tmp_path_factory):
+    path = tmp_path_factory.mktemp("fabric") / "lm.veles.tgz"
+    return _random_lm_artifact(path)
+
+
+def test_fabric_token_identical_vs_single_engine(lm_artifact):
+    """Router correctness on a REAL artifact: greedy responses
+    through a 2-replica fabric are token-identical to one engine —
+    including prompts long enough to ride the prefix cache."""
+    model = ExportedModel(lm_artifact)
+    single = ServingEngine(model, max_batch=4, kv_blocks=33,
+                           kv_block_size=4).start()
+    router = ReplicaRouter(fleet=FleetScheduler())
+    engines = [ServingEngine(model, max_batch=4, kv_blocks=33,
+                             kv_block_size=4).start()
+               for _ in range(2)]
+    for i, engine in enumerate(engines):
+        router.add_replica("r%d" % i, engine)
+    rng = numpy.random.RandomState(7)
+    try:
+        prompts = [rng.randint(0, 13, size=n).astype(numpy.int32)
+                   for n in (3, 6, 9, 12, 12, 9)]
+        # Repeat one prompt so the fabric path exercises a prefix
+        # adoption while the single engine does too.
+        prompts.append(prompts[3].copy())
+        for prompt in prompts:
+            want = single.submit_generate(prompt, 6)
+            got = router.submit_generate(prompt, 6)
+            assert numpy.array_equal(want, got), \
+                "fabric output diverged from the single engine"
+        assert router.occupancy()["routed"] == len(prompts)
+    finally:
+        router.stop(drain=False)
+        single.stop()
+
+
+def test_disagg_prefill_adopt_parity(lm_artifact):
+    """Disaggregated prefill on a REAL artifact: the decode replica
+    adopts wire-shipped KV blocks and still produces exactly the
+    single-engine greedy tokens, with the adoption visible in the
+    pool hit counter."""
+    model = ExportedModel(lm_artifact)
+    single = ServingEngine(model, max_batch=4, kv_blocks=33,
+                           kv_block_size=4).start()
+    prefill = PrefillWorker(
+        ServingEngine(model, max_batch=4, kv_blocks=33,
+                      kv_block_size=4).start())
+    router = ReplicaRouter(fleet=FleetScheduler(), prefill=prefill)
+    decode = ServingEngine(model, max_batch=4, kv_blocks=33,
+                           kv_block_size=4).start()
+    router.add_replica("d0", decode)
+    rng = numpy.random.RandomState(11)
+    try:
+        prompt = rng.randint(0, 13, size=14).astype(numpy.int32)
+        want = single.submit_generate(prompt, 5)
+        got = router.submit_generate(prompt, 5)
+        assert numpy.array_equal(want, got), \
+            "disaggregated decode diverged from the single engine"
+        snap = router.occupancy()
+        assert snap["adopted_blocks"] >= 1, snap
+        assert decode.stats.get("kv.adopt") >= 1
+        # The decode replica's prefill rode the adopted blocks: its
+        # pool saw a prefix hit on a prompt it never prefilled.
+        assert decode.kv_pool.occupancy()["prefix_hits"] >= 1
+        pw = prefill.engine.stats
+        assert pw.get("kv.prefill_exported") >= 1
+    finally:
+        router.stop(drain=False)
+        single.stop()
+
+
+# -- observability ---------------------------------------------------------
+
+
+def test_live_fabric_summary_and_dashboard_row():
+    engines = {n: _paged_engine().start() for n in ("a", "b")}
+    router = ReplicaRouter(fleet=FleetScheduler())
+    for name, engine in engines.items():
+        router.add_replica(name, engine)
+    try:
+        prompt = numpy.arange(16, dtype=numpy.int32)
+        router.submit_generate(prompt, 2)
+        router.submit_generate(prompt, 2)
+        summary = live_fabric_summary()
+        assert summary is not None
+        assert summary["replicas"] >= 2
+        assert summary["routed"] >= 2
+        assert summary.get("prefix_hit_rate", 0) > 0
+        # The heartbeat section web_status scrapes has a dashboard
+        # row (the agreement test_docs_consistency also gates).
+        import inspect
+        from veles_tpu import web_status
+        assert "fabric" in \
+            web_status.WebStatusServer.METRIC_SECTIONS
+        src = inspect.getsource(
+            web_status.WebStatusServer.render_page)
+        assert 'info.get("fabric"' in src
+    finally:
+        router.stop(drain=False)
+        del router

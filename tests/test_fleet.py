@@ -501,3 +501,57 @@ def test_elastic_soak_8_5_8_socket_bit_parity():
     for key in ref:
         assert numpy.array_equal(ref[key], elastic[key]), \
             "trainable %s diverged across the 8->5->8 walk" % key
+
+
+def test_wire_mesh_rebuild_exactly_one_rebuild_per_epoch():
+    """Satellite regression (serving fabric PR): wire_mesh_rebuild
+    auto-subscribes rebuild_mesh to FleetScheduler epoch changes —
+    every join/leave epoch bump triggers EXACTLY one rebuild call
+    stamped with that epoch, duplicates and stale bumps are deduped,
+    and a rebuild that raises never detaches the subscription."""
+    from veles_tpu.fleet import wire_mesh_rebuild
+
+    sched = FleetScheduler()
+    calls = []
+
+    def recorder(workflow, epoch=None):
+        calls.append((workflow, epoch))
+
+    sentinel = object()
+    cb = wire_mesh_rebuild(sched, sentinel, rebuild=recorder)
+    assert cb is not None
+
+    sched.join("a")                    # epoch 1
+    sched.join("b")                    # epoch 2
+    sched.leave("a", clean=True)       # epoch 3 (drain)
+    sched.leave("b", clean=False)      # epoch 4 (drop)
+    assert calls == [(sentinel, 1), (sentinel, 2),
+                     (sentinel, 3), (sentinel, 4)]
+
+    # A stale/duplicate notification is deduped, not re-applied.
+    cb(2, "join", "late")
+    assert len(calls) == 4
+
+    # A raising rebuild is logged, not fatal, and the subscription
+    # survives for the next epoch.
+    def flaky(workflow, epoch=None):
+        calls.append((workflow, epoch))
+        if epoch == 5:
+            raise RuntimeError("mesh re-form failed")
+
+    sched2 = FleetScheduler()
+    wire_mesh_rebuild(sched2, sentinel, rebuild=flaky)
+    # Pre-bump epochs so the first fire lands on 5.
+    for sid in ("w0", "w1", "w2", "w3"):
+        sched2.join(sid)
+    del calls[:]
+    sched2.join("w4")                  # epoch 5: raises inside
+    sched2.leave("w4", clean=True)     # epoch 6: still subscribed
+    assert [e for _, e in calls] == [5, 6]
+
+    # Default rebuild target is the real rebuild_mesh.
+    from veles_tpu.parallel.mesh import rebuild_mesh
+    import inspect
+    default = inspect.signature(wire_mesh_rebuild).parameters
+    assert default["rebuild"].default is None  # resolved lazily
+    assert callable(rebuild_mesh)

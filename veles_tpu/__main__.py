@@ -328,6 +328,13 @@ class Main(Logger, CommandLineBase):
                 args.serve_reload_watch
         if args.serve_reload_poll is not None:
             root.common.serving.reload_poll = args.serve_reload_poll
+        if args.serve_fabric_replicas is not None:
+            root.common.serving.fabric_replicas = \
+                args.serve_fabric_replicas
+        if args.serve_fabric_disagg:
+            root.common.serving.fabric_disagg = True
+        if args.serve_tenant:
+            root.common.serving.tenant = list(args.serve_tenant)
         # Attention fast-path knobs (ops/attention.init_parser;
         # docs/attention.md) — read back at unit construction
         # (fused_qkv freezes the parameter layout) and inside the

@@ -517,6 +517,65 @@ class KVBlockPool(object):
                 _, ids = self._prefix.popitem(last=False)
                 self._release_locked(ids)
 
+    # -- cross-pool export / adoption ------------------------------------
+
+    def export_prefix_blocks(self, tokens, chain=None):
+        """The longest cached full-block prefix of ``tokens`` as an
+        EXPORTABLE handle: ``(n_full_blocks, block_ids)`` with one
+        ref per block taken for the caller — identical contract to
+        :meth:`lookup_prefix`, named for the disaggregation wire
+        (docs/serving.md "Serving fabric"): the caller serializes the
+        addressed device blocks (``ExportedModel.export_kv_blocks``)
+        and then MUST :meth:`release` the ids.  The refs pin the
+        blocks against eviction/COW while their bytes are in flight."""
+        return self.lookup_prefix(tokens, chain=chain)
+
+    def adopt_prefix_blocks(self, tokens, n_blocks, write_fn=None,
+                            chain=None):
+        """Adopts ``n_blocks`` full blocks of remotely-prefilled KV
+        into THIS pool's prefix cache: allocates destination blocks,
+        lets ``write_fn(ids)`` scatter the shipped tensor data into
+        them (``ExportedModel.import_kv_blocks``), then registers
+        every full-block prefix so the next local request with the
+        same prompt adopts the blocks instead of re-prefilling.
+
+        Refcount-correct by construction: after registration the
+        alloc refs are RELEASED, so the prefix-cache entries are the
+        only owners — block ``j`` (0-based) is held by entries
+        ``j+1 .. n`` exactly as a locally-prefilled prefix would be,
+        and LRU eviction / ``drop_prefixes`` return the blocks to the
+        free list with no residue.  Idempotent: if the full chain is
+        already cached the existing ids are returned untouched.
+        Returns the block ids, or None when the pool cannot supply
+        ``n_blocks`` even after evicting colder prefixes (the caller
+        skips adoption — it is an optimization, never load-bearing)."""
+        if chain is None:
+            chain = self.prefix_chain(tokens)
+        n_blocks = min(int(n_blocks), len(chain))
+        if n_blocks <= 0:
+            return []
+        with self._lock:
+            ids = self._prefix.get(chain[n_blocks - 1])
+            if ids is not None:
+                self._prefix.move_to_end(chain[n_blocks - 1])
+                return list(ids)
+        ids = self.alloc(n_blocks)
+        if ids is None:
+            return None
+        if write_fn is not None:
+            try:
+                write_fn(ids)
+            except Exception:
+                self.release(ids)
+                raise
+        bs = self.block_size
+        tokens = numpy.ascontiguousarray(tokens,
+                                         dtype=numpy.int32)
+        self.register_prefix(tokens[:n_blocks * bs], ids,
+                             chain=chain[:n_blocks])
+        self.release(ids)
+        return ids
+
     # -- copy-on-write ---------------------------------------------------
 
     def cow_copy(self, block_id):
@@ -1684,6 +1743,44 @@ class ExportedModel(object):
         src_dst = jax.device_put((numpy.int32(src),
                                   numpy.int32(dst)))
         return fn(ks, vs, *src_dst)
+
+    def export_kv_blocks(self, pool, ids):
+        """The addressed pool blocks as ONE host array ``(L, 2, n,
+        block_size, H, D)`` f32 (k then v per layer) — the tensor the
+        disaggregation wire ships (``serving.fabric.disagg`` frames
+        it zero-copy via ``encode_tensor_parts``).  The caller holds
+        refs on ``ids`` (``export_prefix_blocks``) so the device rows
+        cannot be reused mid-read."""
+        idx = numpy.asarray(list(ids), dtype=numpy.int32)
+        ks, vs = pool.storage
+        return numpy.stack(
+            [numpy.stack([numpy.asarray(k[idx]),
+                          numpy.asarray(v[idx])])
+             for k, v in zip(ks, vs)])
+
+    def import_kv_blocks(self, pool, ids, blocks):
+        """Scatters a shipped ``(L, 2, n, block_size, H, D)`` host
+        array (from :meth:`export_kv_blocks` on the peer) into THIS
+        pool's storage at ``ids``.  Produces new per-layer device
+        tensors functionally, exactly like the COW copy — callers on
+        the serving path route through the engine's device-thread op
+        queue so the write never races a donated decode step."""
+        import jax.numpy as jnp
+        blocks = numpy.asarray(blocks, dtype=numpy.float32)
+        idx = jnp.asarray(list(ids), dtype=jnp.int32)
+        ks, vs = pool.storage
+        L = len(ks)
+        if blocks.shape[:2] != (L, 2) or \
+                blocks.shape[2] != len(ids) or \
+                blocks.shape[3:] != ks[0].shape[1:]:
+            raise Bug("imported KV block shape %s does not match "
+                      "pool geometry (L=%d, block=%s, n=%d)" %
+                      (blocks.shape, L, ks[0].shape[1:], len(ids)))
+        ks = [k.at[idx].set(jnp.asarray(blocks[i, 0]))
+              for i, k in enumerate(ks)]
+        vs = [v.at[idx].set(jnp.asarray(blocks[i, 1]))
+              for i, v in enumerate(vs)]
+        pool.storage = (ks, vs)
 
     def _paged_block(self, p, x, pk, pv, tables, wblock, wslot,
                      key_mask, n_heads, attend=None):

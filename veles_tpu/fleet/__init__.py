@@ -7,4 +7,5 @@ serving replicas, respawns) places through.  See
 from .scheduler import (  # noqa: F401
     FleetScheduler,
     live_fleet_summary,
+    wire_mesh_rebuild,
 )

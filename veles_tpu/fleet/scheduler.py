@@ -101,9 +101,34 @@ class FleetScheduler(object):
         self.leaves = 0
         self.drains = 0
         self.events = deque(maxlen=self.MAX_EVENTS)
+        self._epoch_callbacks = []  # guarded-by: _lock
         _LIVE_SCHEDULERS.add(self)
 
     # -- membership --------------------------------------------------------
+
+    def on_epoch_change(self, callback):
+        """Subscribes ``callback(epoch, event, sid)`` to every
+        membership-epoch bump (``event`` is ``"join"`` / ``"drain"``
+        / ``"drop"``).  Callbacks fire on the thread that caused the
+        bump, OUTSIDE the scheduler lock — a subscriber may call back
+        into the scheduler (snapshot, placement) but must do its own
+        serialization for anything heavier.  This is how the SPMD
+        mesh layer follows the fleet without caller wiring: see
+        :func:`wire_mesh_rebuild`."""
+        with self._lock:
+            self._epoch_callbacks.append(callback)
+
+    def _notify_epoch(self, epoch, event, sid):
+        with self._lock:
+            callbacks = list(self._epoch_callbacks)
+        for cb in callbacks:
+            try:
+                cb(epoch, event, sid)
+            except Exception:
+                import logging
+                logging.getLogger("FleetScheduler").exception(
+                    "epoch-change callback failed (epoch %d %s %s)",
+                    epoch, event, sid)
 
     def join(self, sid, mid=None, power=1.0):
         """Admits ``sid``; returns the new membership epoch."""
@@ -117,6 +142,7 @@ class FleetScheduler(object):
             epoch = self.epoch
         resilience.stats.incr("fleet.join")
         self._publish_gauges()
+        self._notify_epoch(epoch, "join", sid)
         return epoch
 
     def leave(self, sid, clean=False):
@@ -140,6 +166,7 @@ class FleetScheduler(object):
         if clean:
             resilience.stats.incr("fleet.drain")
         self._publish_gauges()
+        self._notify_epoch(epoch, "drain" if clean else "drop", sid)
         return epoch
 
     @property
@@ -218,3 +245,32 @@ class FleetScheduler(object):
     def __repr__(self):
         return "FleetScheduler(epoch=%d, size=%d)" % (
             self.epoch, len(self.members))
+
+
+def wire_mesh_rebuild(scheduler, workflow, rebuild=None):
+    """Auto-wires SPMD mesh rebuilds to fleet membership epochs — the
+    remaining half of ROADMAP item 5's plumbing: today ``rebuild_mesh``
+    is called explicitly by whoever noticed the fleet changed; after
+    this call it follows the scheduler's epoch bumps directly.
+
+    Exactly ONE rebuild fires per epoch bump (re-entrant joins/leaves
+    from inside a rebuild are deduped by epoch number), each stamped
+    with the epoch that caused it so ``workflow._membership_epoch_``
+    and ``membership.epoch`` agree.  ``rebuild`` is injectable for
+    tests; it defaults to :func:`veles_tpu.parallel.mesh.rebuild_mesh`.
+    Returns the subscribed callback (handy for asserting wiring)."""
+    if rebuild is None:
+        from ..parallel.mesh import rebuild_mesh as rebuild
+
+    state = {"last": scheduler.epoch}
+    state_lock = threading.Lock()
+
+    def _on_epoch(epoch, event, sid):
+        with state_lock:
+            if epoch <= state["last"]:
+                return
+            state["last"] = epoch
+        rebuild(workflow, epoch=epoch)
+
+    scheduler.on_epoch_change(_on_epoch)
+    return _on_epoch

@@ -517,6 +517,17 @@ class Launcher(Logger):
             serving = None
         if serving:
             payload["serving"] = serving
+        # Fabric row: replica membership, routed totals, and the
+        # cross-replica prefix hit-rate from any live serving fabric
+        # router in this process (docs/serving.md "Serving fabric").
+        try:
+            from .serving.fabric import live_fabric_summary
+            fabric = live_fabric_summary()
+        except Exception as e:
+            self.debug("fabric heartbeat section unavailable: %s", e)
+            fabric = None
+        if fabric:
+            payload["fabric"] = fabric
         # Population row: member fitness, lineage generations, and
         # exploit/requeue counts from any live population master in
         # this process (docs/population.md).

@@ -122,6 +122,25 @@ def init_parser(parser):
         "--serve-reload-poll", type=float, default=None,
         metavar="SEC",
         help="serving: reload-watch poll interval (default 5)")
+    parser.add_argument(
+        "--serve-fabric-replicas", type=int, default=None,
+        metavar="N",
+        help="serving fabric: run N engine replicas behind the "
+             "prefix-affinity router (default 1: no fabric)")
+    parser.add_argument(
+        "--serve-fabric-disagg", action="store_true",
+        help="serving fabric: disaggregate prefill from decode — a "
+             "dedicated prefill worker fills KV blocks and ships "
+             "them to the decode replicas over the zero-copy tensor "
+             "wire")
+    parser.add_argument(
+        "--serve-tenant", action="append", default=None,
+        metavar="NAME=RATE[:BURST][@ARTIFACT]",
+        help="serving fabric: register a tenant with a token-bucket "
+             "quota (repeatable); once any tenant is registered, "
+             "requests without a known X-Tenant get 403 and "
+             "over-quota tenants get 429 + Retry-After without "
+             "shedding siblings")
 
 
 def serving_config_defaults():
@@ -132,7 +151,8 @@ def serving_config_defaults():
                 "token", "warmup", "kv_blocks", "kv_block_size",
                 "paged", "drain_timeout", "reload_watch",
                 "reload_poll", "spec", "spec_draft", "spec_max_k",
-                "spec_draft_blocks"):
+                "spec_draft_blocks", "fabric_replicas",
+                "fabric_disagg", "tenant"):
         value = root.common.serving.get(key)
         if value is not None:
             out[key] = value
@@ -172,19 +192,58 @@ class ModelServer(JsonHttpServer):
                  paged=None, kv_blocks=None, kv_block_size=16,
                  drain_timeout=30.0, reload_watch=None,
                  reload_poll=5.0, spec=False, spec_draft=None,
-                 spec_max_k=4, spec_draft_blocks=None):
+                 spec_max_k=4, spec_draft_blocks=None,
+                 fabric_replicas=1, fabric_disagg=False,
+                 tenant=None):
         if isinstance(model, str):
             model = ExportedModel(model)
         self.token = token
         self.deadline = deadline
         self.warmup = warmup
-        self.engine = ServingEngine(
-            model, max_batch=max_batch, queue_depth=queue_depth,
-            policy=policy, default_deadline=deadline, paged=paged,
-            kv_blocks=kv_blocks, kv_block_size=kv_block_size,
-            spec=spec, spec_draft=spec_draft, spec_max_k=spec_max_k,
-            spec_draft_blocks=spec_draft_blocks,
-            drain_timeout=drain_timeout)
+
+        def build_engine():
+            # Replicas share the MODEL object (weights + compile
+            # cache: one warmup covers the fleet) but own their
+            # queue, device thread, and KV pool.
+            return ServingEngine(
+                model, max_batch=max_batch,
+                queue_depth=queue_depth, policy=policy,
+                default_deadline=deadline, paged=paged,
+                kv_blocks=kv_blocks, kv_block_size=kv_block_size,
+                spec=spec, spec_draft=spec_draft,
+                spec_max_k=spec_max_k,
+                spec_draft_blocks=spec_draft_blocks,
+                drain_timeout=drain_timeout)
+
+        self.engine = build_engine()
+        self.fabric = None
+        self._fabric_engines = [self.engine]
+        fabric_replicas = int(fabric_replicas or 1)
+        if fabric_replicas > 1 or fabric_disagg or tenant:
+            from .serving.fabric import (ModelRegistry,
+                                         PrefillWorker,
+                                         ReplicaRouter,
+                                         parse_tenant_spec)
+            registry = None
+            if tenant:
+                registry = ModelRegistry()
+                specs = [tenant] if isinstance(tenant, str) \
+                    else list(tenant)
+                for spec in specs:
+                    name, rate, burst, artifact = \
+                        parse_tenant_spec(spec) \
+                        if isinstance(spec, str) else spec
+                    registry.register(name, rate=rate, burst=burst,
+                                      artifact=artifact)
+            prefill = PrefillWorker(build_engine()) \
+                if fabric_disagg else None
+            self.fabric = ReplicaRouter(registry=registry,
+                                        prefill=prefill)
+            self.fabric.add_replica("r0", self.engine)
+            for i in range(1, fabric_replicas):
+                engine = build_engine()
+                self._fabric_engines.append(engine)
+                self.fabric.add_replica("r%d" % i, engine)
         self.limiter = RateLimiter(rate_limit) if rate_limit else None
         self.reload_watch = reload_watch
         self.reload_poll = reload_poll
@@ -239,6 +298,15 @@ class ModelServer(JsonHttpServer):
                     return Deadline(want) if want else None
                 return Deadline(max(0.0, min(want, budget)))
 
+            def _tenant(self, payload):
+                """Tenant identity: the ``X-Tenant`` header wins,
+                else ``payload["tenant"]``, else anonymous (the
+                ``default`` tenant when tenancy is configured)."""
+                tenant = self.headers.get("X-Tenant")
+                if tenant is None and isinstance(payload, dict):
+                    tenant = payload.get("tenant")
+                return tenant
+
             def do_POST(self):
                 outer = self.outer
                 if self.path == "/api/generate":
@@ -270,8 +338,9 @@ class ModelServer(JsonHttpServer):
                     self.reply(400, {"error": str(e)})
                     return
                 try:
-                    probs = outer.engine.submit_classify(
-                        x, deadline=self._deadline(payload))
+                    probs = outer.submit_classify(
+                        x, deadline=self._deadline(payload),
+                        tenant=self._tenant(payload))
                     flat = probs.reshape(probs.shape[0], -1)
                     self.reply(200, {
                         "output": flat,
@@ -331,9 +400,10 @@ class ModelServer(JsonHttpServer):
                     self.reply(400, {"error": str(e)})
                     return
                 try:
-                    full = outer.engine.submit_generate(
+                    full = outer.submit_generate(
                         tokens, max_new, temperature=temperature,
-                        seed=seed, deadline=self._deadline(payload))
+                        seed=seed, deadline=self._deadline(payload),
+                        tenant=self._tenant(payload))
                 except AdmissionError as e:
                     self.reply(e.status, {"error": str(e)},
                                headers=_retry_headers(e))
@@ -408,6 +478,25 @@ class ModelServer(JsonHttpServer):
         moment it lands."""
         return self.engine.model
 
+    def submit_generate(self, tokens, max_new, temperature=0.0,
+                        seed=0, deadline=None, tenant=None):
+        """Generate through the fabric when one is configured
+        (tenant admission + prefix-affine replica routing), else
+        straight into the single engine."""
+        if self.fabric is not None:
+            return self.fabric.submit_generate(
+                tokens, max_new, temperature=temperature, seed=seed,
+                deadline=deadline, tenant=tenant)
+        return self.engine.submit_generate(
+            tokens, max_new, temperature=temperature, seed=seed,
+            deadline=deadline)
+
+    def submit_classify(self, x, deadline=None, tenant=None):
+        if self.fabric is not None:
+            return self.fabric.submit_classify(x, deadline=deadline,
+                                               tenant=tenant)
+        return self.engine.submit_classify(x, deadline=deadline)
+
     def reload_artifact(self, path=None, require_manifest=None):
         """Verify-and-reload: ``path`` (default: whatever the watch
         target currently names) is read once, gated through its
@@ -476,6 +565,8 @@ class ModelServer(JsonHttpServer):
         if self.limiter is not None:
             payload["rate_limit"] = {"rate": self.limiter.rate,
                                      "clients": len(self.limiter)}
+        if self.fabric is not None:
+            payload["fabric"] = self.fabric.occupancy()
         return payload
 
     def metrics_text(self):
@@ -498,8 +589,13 @@ class ModelServer(JsonHttpServer):
             [obs_metrics.registry, stats.registry])
 
     def _spin_up(self):
-        self.engine.start()
+        for engine in self._fabric_engines:
+            engine.start()
+        if self.fabric is not None and self.fabric.prefill is not None:
+            self.fabric.prefill.engine.start()
         if self.warmup:
+            # Replicas share the model's compile cache: warming the
+            # primary warms the program family for the whole fleet.
             self.engine.warmup()
         if self.reload_watch is not None and self.watcher is None:
             from .serving.reload import ArtifactWatcher
@@ -526,11 +622,17 @@ class ModelServer(JsonHttpServer):
             self.watcher.stop()
             self.watcher = None
         if drain:
-            self.engine.stop(drain=True, timeout=timeout)
+            if self.fabric is not None:
+                self.fabric.stop(drain=True, timeout=timeout)
+            else:
+                self.engine.stop(drain=True, timeout=timeout)
             super(ModelServer, self).stop()
         else:
             super(ModelServer, self).stop()
-            self.engine.stop()
+            if self.fabric is not None:
+                self.fabric.stop(drain=False, timeout=timeout)
+            else:
+                self.engine.stop()
 
 
 def _retry_headers(e):
@@ -581,6 +683,9 @@ class RESTfulAPI(Unit):
         self.spec_max_k = kwargs.get("spec_max_k", 4)
         self.spec_draft_blocks = kwargs.get("spec_draft_blocks",
                                             None)
+        self.fabric_replicas = kwargs.get("fabric_replicas", 1)
+        self.fabric_disagg = kwargs.get("fabric_disagg", False)
+        self.tenant = kwargs.get("tenant", None)
         self.server = None
 
     def run(self):
@@ -599,7 +704,10 @@ class RESTfulAPI(Unit):
             spec_draft_blocks=self.spec_draft_blocks,
             drain_timeout=self.drain_timeout,
             reload_watch=self.reload_watch,
-            reload_poll=self.reload_poll)
+            reload_poll=self.reload_poll,
+            fabric_replicas=self.fabric_replicas,
+            fabric_disagg=self.fabric_disagg,
+            tenant=self.tenant)
         self.port = self.server.port
         if self.blocking:
             self.server.serve()

@@ -8,8 +8,11 @@ speculative decoding (``--spec`` n-gram drafting, ``--spec-draft``
 draft model, ``--spec-max-k`` verify width),
 ``--warmup`` grid precompilation, per-client rate limiting,
 queue-depth backpressure, hot weight reload (``--reload-watch`` /
-authenticated ``POST /admin/reload``) and graceful SIGTERM drain
-(``--drain-timeout``) — docs/serving.md."""
+authenticated ``POST /admin/reload``), graceful SIGTERM drain
+(``--drain-timeout``) — and the serving FABRIC above one engine:
+``--fabric-replicas`` prefix-affinity routing over N replicas,
+``--fabric-disagg`` prefill/decode disaggregation, ``--tenant``
+multi-tenant quota admission — docs/serving.md."""
 
 import argparse
 import signal
@@ -97,6 +100,27 @@ def main(argv=None):
     parser.add_argument(
         "--reload-poll", type=float, default=5.0, metavar="SEC",
         help="reload-watch poll interval (default 5)")
+    parser.add_argument(
+        "--fabric-replicas", type=int, default=1, metavar="N",
+        help="serving fabric: run N engine replicas behind the "
+             "prefix-affinity consistent-hash router — requests "
+             "sharing a prompt prefix land on the same replica and "
+             "hit its KV prefix cache (default 1: no fabric)")
+    parser.add_argument(
+        "--fabric-disagg", action="store_true",
+        help="serving fabric: disaggregate prefill from decode — a "
+             "dedicated prefill worker fills KV blocks and ships "
+             "them to decode replicas as versioned tensors over the "
+             "zero-copy wire, so long prefills never stall decoding "
+             "streams")
+    parser.add_argument(
+        "--tenant", action="append", default=None,
+        metavar="NAME=RATE[:BURST][@ARTIFACT]",
+        help="serving fabric: register a tenant with a token-bucket "
+             "quota (repeatable); once any tenant is registered, "
+             "requests without a known X-Tenant get 403 and "
+             "over-quota tenants get 429 + Retry-After — without "
+             "shedding sibling tenants")
     args = parser.parse_args(argv)
     server = ModelServer(
         args.artifact, host=args.host, port=args.port,
@@ -110,7 +134,10 @@ def main(argv=None):
         spec_draft_blocks=args.spec_draft_blocks,
         drain_timeout=args.drain_timeout,
         reload_watch=args.reload_watch,
-        reload_poll=args.reload_poll)
+        reload_poll=args.reload_poll,
+        fabric_replicas=args.fabric_replicas,
+        fabric_disagg=args.fabric_disagg,
+        tenant=args.tenant)
     install_sigterm_drain(server)
     try:
         server.serve()

@@ -33,16 +33,23 @@ between the HTTP handlers (:mod:`veles_tpu.restful`) and the device:
   budgets; one ``paged_verify`` dispatch scores K draft tokens plus
   a bonus position.
 
-Future inference PRs (multi-host serving) build on this layer; see
-docs/serving.md.
+* :mod:`~veles_tpu.serving.fabric` — the tier ABOVE one engine: a
+  replica router with prefix-affinity consistent hashing,
+  prefill/decode disaggregation over the zero-copy tensor wire, and
+  a multi-tenant model registry with per-tenant quota admission.
+
+See docs/serving.md.
 """
 
-from .admission import (AdmissionError, DeadlineExceeded,  # noqa: F401
+from .admission import (AdmissionError, DeadlineExceeded,  # noqa: F401,E501
                         EngineStopped, PoolExhausted, QueueFull,
                         RateLimited, RateLimiter, ServiceUnavailable,
                         TokenBucket)
 from .buckets import BucketPolicy, CompileCache, next_pow2  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
+from .fabric import (ModelRegistry, PrefillWorker,  # noqa: F401
+                     ReplicaRouter, TenantUnknown,
+                     live_fabric_summary, parse_tenant_spec)
 from .metrics import ServingStats  # noqa: F401
 from .reload import (ArtifactRejected, ArtifactWatcher,  # noqa: F401
                      read_verified, resolve_artifact)

@@ -576,7 +576,152 @@ class ServingEngine(Logger):
                   else "replaced + pool rebuilt", len(live))
         return getattr(self.draft_model, "weight_version", 1)
 
+    def adopt_kv_prefix(self, tokens, payload, timeout=30.0):
+        """Adopts remotely-prefilled KV blocks into this engine's
+        pool — the decode-side half of prefill/decode disaggregation
+        (:mod:`veles_tpu.serving.fabric.disagg`).  ``payload`` is an
+        unpacked disagg dict (``unpack_kv_payload``): full-block k/v
+        tensors plus the ``weight_version`` they were computed under.
+        The write rides the device-thread op queue exactly like an
+        in-place reload (applied at a decode-step boundary), because
+        importing into ``pool.storage`` from another thread would
+        race the decode step's donated buffers.  Returns the number
+        of blocks adopted (0 = refused: version skew, dense engine,
+        or pool exhaustion — adoption is an optimization; the prompt
+        simply prefills locally)."""
+        if not self.paged:
+            return 0
+        if int(payload.get("weight_version", -1)) != \
+                int(self.weight_version):
+            # KV computed under other weights must never serve this
+            # model — exactly why reload() flushes the prefix cache.
+            self.stats.incr("kv.adopt_stale")
+            return 0
+        if self._thread is None:
+            return self._apply_kv_adopt(tokens, payload)
+        op = {"kv": (tokens, payload), "same": True,
+              "event": threading.Event(), "result": None,
+              "error": None}
+        with self._cond:
+            if self._stopped:
+                raise EngineStopped("serving engine is not running")
+            self._ops.append(op)
+            self._cond.notify_all()
+        if not op["event"].wait(timeout):
+            with self._cond:
+                try:
+                    self._ops.remove(op)
+                except ValueError:
+                    pass
+            return 0
+        if op["error"] is not None:
+            raise op["error"]
+        return op["result"]
+
+    def export_kv_prefix(self, tokens, timeout=30.0):
+        """Exports the prompt's cached full KV blocks for the wire —
+        the prefill-side half of disaggregation.  Returns
+        ``(n_blocks, blocks, block_size, weight_version)`` with
+        ``blocks`` the ``(L, 2, n, bs, H, D)`` host array from
+        ``export_kv_blocks``, or None when the engine is dense, the
+        pool holds no COMPLETE chain for the prompt (the caller
+        prefills once and retries), or the timeout expires.  Rides
+        the device-thread op queue for the same reason adoption
+        does: reading ``pool.storage`` from another thread races the
+        decode step's donated buffers."""
+        if not self.paged:
+            return None
+        if self._thread is None:
+            return self._apply_kv_export(tokens)
+        op = {"kv_export": tokens, "same": True,
+              "event": threading.Event(), "result": None,
+              "error": None}
+        with self._cond:
+            if self._stopped:
+                raise EngineStopped("serving engine is not running")
+            self._ops.append(op)
+            self._cond.notify_all()
+        if not op["event"].wait(timeout):
+            with self._cond:
+                try:
+                    self._ops.remove(op)
+                except ValueError:
+                    pass
+            return None
+        if op["error"] is not None:
+            raise op["error"]
+        return op["result"]
+
+    def _apply_kv_export(self, tokens):
+        """Device-thread body of :meth:`export_kv_prefix`."""
+        self._ensure_pool()
+        pool = self.kv_pool
+        if pool is None or len(tokens) < pool.block_size:
+            return None
+        chain = pool.prefix_chain(tokens)
+        if not chain:
+            return None
+        n, ids = pool.export_prefix_blocks(tokens, chain=chain)
+        if n < len(chain):
+            # Partial coverage would ship a prefix the decode side
+            # must finish anyway — the caller prefills locally once
+            # and re-exports the full chain.
+            if n:
+                pool.release(ids)
+            return None
+        try:
+            blocks = self.model.export_kv_blocks(pool, ids)
+        finally:
+            pool.release(ids)
+        return (n, blocks, pool.block_size, self.weight_version)
+
+    def _apply_kv_adopt(self, tokens, payload):
+        """Device-thread body of :meth:`adopt_kv_prefix`."""
+        self._ensure_pool()
+        pool = self.kv_pool
+        if pool is None or \
+                pool.block_size != int(payload["block_size"]):
+            return 0
+        chain = pool.prefix_chain(tokens)
+        n = min(int(payload["n_blocks"]), len(chain))
+        if n <= 0:
+            return 0
+        blocks = payload["blocks"]
+
+        def write(ids):
+            self.model.import_kv_blocks(pool, ids,
+                                        blocks[:, :, :len(ids)])
+
+        ids = pool.adopt_prefix_blocks(tokens, n, write_fn=write,
+                                       chain=chain)
+        if ids is None:
+            self.stats.incr("kv.adopt_shed")
+            return 0
+        self.stats.incr("kv.adopt")
+        return len(ids)
+
     def _apply_reload_op(self, op):
+        if op.get("kv_export") is not None:
+            try:
+                op["result"] = self._apply_kv_export(
+                    op["kv_export"])
+            except Exception as e:  # surfaced to export_kv_prefix()
+                self.exception("KV export failed — the decode side "
+                               "prefills locally instead")
+                op["error"] = e
+            finally:
+                op["event"].set()
+            return
+        if op.get("kv"):
+            try:
+                op["result"] = self._apply_kv_adopt(*op["kv"])
+            except Exception as e:  # surfaced to adopt_kv_prefix()
+                self.exception("KV adoption failed — the prompt "
+                               "prefills locally instead")
+                op["error"] = e
+            finally:
+                op["event"].set()
+            return
         if op.get("draft"):
             try:
                 op["result"] = self._apply_draft_reload(op["new"])

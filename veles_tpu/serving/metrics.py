@@ -124,6 +124,18 @@ class ServingStats(object):
             "serving.latency_seconds",
             labels={"kind": key}).observe(seconds)
 
+    def latency_samples(self, key):
+        """The named latency window's recent samples (seconds, a
+        copy) — the fabric bench merges these ACROSS replicas before
+        taking percentiles: percentiles of percentiles are not
+        percentiles, raw samples pool correctly."""
+        with self._lock:
+            win = self._latency.get(key)
+        if win is None:
+            return []
+        with win._lock:
+            return list(win._ring[:min(win._n, win.size)])
+
     def gauge(self, name):
         """The latest value of a named gauge, or None — the engine's
         EWMA speculative gauges read back through this."""

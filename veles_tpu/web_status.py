@@ -148,7 +148,7 @@ class WebStatusServer(JsonHttpServer):
     #: labeled Prometheus gauges on ``GET /metrics`` — ONE scrape
     #: endpoint covers every master this dashboard tracks.
     METRIC_SECTIONS = ("comms", "resilience", "perf", "serving",
-                      "population", "fleet", "metrics")
+                      "fabric", "population", "fleet", "metrics")
 
     def metrics_text(self):
         """Prometheus text exposition: this process's own registry
@@ -241,6 +241,14 @@ class WebStatusServer(JsonHttpServer):
                 esc(json.dumps(population, sort_keys=True))
                 if isinstance(population, dict) and population
                 else "")
+            # Fabric row: replica count, draining, routed totals and
+            # the cross-replica prefix hit-rate from any serving
+            # fabric riding the beat (docs/serving.md).
+            fabric = info.get("fabric")
+            fabric_row = (
+                "<tr><th>fabric</th><td>%s</td></tr>" %
+                esc(json.dumps(fabric, sort_keys=True))
+                if isinstance(fabric, dict) and fabric else "")
             # Fleet row: membership epoch, live size, and the
             # join/leave/drain tallies from the elastic fleet's
             # heartbeat section (docs/distributed.md).
@@ -254,14 +262,14 @@ class WebStatusServer(JsonHttpServer):
                 "<table><tr><th>mode</th><td>%s</td></tr>"
                 "<tr><th>epoch</th><td>%s</td></tr>"
                 "<tr><th>runtime</th><td>%.0f s</td></tr>"
-                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s%s%s%s%s"
+                "<tr><th>metrics</th><td>%s</td></tr>%s%s%s%s%s%s%s%s"
                 "</table>" %
                 (esc(info.get("workflow", "?")), esc(mid),
                  esc(info.get("mode", "?")), esc(info.get("epoch", "?")),
                  runtime,
                  esc(json.dumps(info.get("metrics", {}))),
                  health_row, resilience_row, comms_row,
-                 serving_row, perf_row, population_row,
+                 serving_row, fabric_row, perf_row, population_row,
                  fleet_row) +
                 ("<h3>workers</h3><table><tr><th>id</th><th>state"
                  "</th><th>jobs</th><th>jobs/s</th></tr>%s</table>"
