@@ -107,7 +107,8 @@ BENCH_FLAGS = ("--mlp", "--lm", "--lm-toy", "--serve", "--streamed",
                "--moe-topk", "--moe-experts", "--population",
                "--population-members", "--population-epochs",
                "--population-ticks", "--elastic", "--elastic-jobs",
-               "--replicas", "--fabric-disagg")
+               "--replicas", "--fabric-disagg", "--kv-dtype",
+               "--net-dtype")
 
 # Tuned on v5e (round 2): batch 512 × 32-tick blocks; larger batches
 # or blocks gain <3% more.  The perf levers that got here: banded-
@@ -400,6 +401,7 @@ def serve_bench(argv):
     spec_ab = "--spec" in argv
     replicas = 1
     disagg = "--fabric-disagg" in argv
+    kv_dtype = None
     for i, arg in enumerate(argv):
         if arg.startswith("--serve-streams="):
             streams = int(arg.split("=", 1)[1])
@@ -409,6 +411,10 @@ def serve_bench(argv):
             replicas = int(arg.split("=", 1)[1])
         elif arg == "--replicas" and i + 1 < len(argv):
             replicas = int(argv[i + 1])
+        elif arg.startswith("--kv-dtype="):
+            kv_dtype = arg.split("=", 1)[1]
+        elif arg == "--kv-dtype" and i + 1 < len(argv):
+            kv_dtype = argv[i + 1]
     if replicas > 1 or disagg:
         return serve_fabric_bench(streams, seconds, replicas,
                                   disagg)
@@ -423,7 +429,7 @@ def serve_bench(argv):
     news = SERVE_SPEC_NEW_CHOICES if spec_ab else SERVE_NEW_CHOICES
 
     def one_mode(paged, kv_blocks=None, spec=False,
-                 spec_adaptive=True):
+                 spec_adaptive=True, kv_dtype=None):
         from veles_tpu.serving import BucketPolicy
         model = ExportedModel(path, compile_capacity=256)
         engine = ServingEngine(
@@ -436,7 +442,7 @@ def serve_bench(argv):
                                 batch_floor=8,
                                 prompt_cap=SERVE_POS),
             paged=paged, kv_blocks=kv_blocks,
-            kv_block_size=SERVE_KV_BLOCK,
+            kv_block_size=SERVE_KV_BLOCK, kv_dtype=kv_dtype,
             spec=spec, spec_max_k=SERVE_SPEC_K,
             spec_adaptive=spec_adaptive)
         engine.start()
@@ -455,6 +461,9 @@ def serve_bench(argv):
 
     if spec_ab:
         return serve_spec_ab(one_mode, streams, seconds)
+    if kv_dtype and kv_dtype != "f32":
+        return serve_kv_quant_ab(one_mode, streams, seconds,
+                                 kv_dtype, path)
 
     # The paged pool is deliberately sized BELOW the worst case
     # (max_batch full-length rows) so the soak drives it past
@@ -496,6 +505,68 @@ def serve_bench(argv):
         "kv_prefix_hits": occ.get("prefix_hits"),
         "kv_cow_copies": occ.get("cow_copies"),
         "dense_tok_per_sec": round(dense_tps, 1),
+    }))
+
+
+def serve_kv_quant_ab(one_mode, streams, seconds, kv_dtype, path):
+    """``--serve --kv-dtype={bf16,int8,fp8}`` (BENCH_r13): the
+    quantized-KV capacity A/B.  Both sides get the SAME HBM byte
+    budget — the headline soak's deliberately undersized f32 pool,
+    in bytes — converted to each storage dtype's block count, then
+    run the same mixed-geometry soak.  The figure of merit is
+    capacity: streams held before the first PoolExhausted shed.
+    Admission reserves each stream's worst-case blocks at the door,
+    so capacity is exactly usable-blocks // worst-case-rows — int8
+    fits ~4x the blocks (minus the per-(block, head) f32 scale
+    sidecar) in the budget, and the soak's shed counts show the
+    extra headroom live.  Token-level quality is NOT this bench's
+    claim: the greedy-parity and perplexity gates live in tier-1
+    (tests/test_quant.py)."""
+    from veles_tpu.export import ExportedModel, check_kv_dtype
+    kv_dtype = check_kv_dtype(kv_dtype)
+    model = ExportedModel(path)
+    per_row = -(-(max(SERVE_PROMPT_CHOICES) +
+                  max(SERVE_NEW_CHOICES)) // SERVE_KV_BLOCK)
+    block_bytes = {
+        dt: model.make_kv_pool(2, SERVE_KV_BLOCK,
+                               kv_dtype=dt).block_bytes
+        for dt in ("f32", kv_dtype)}
+    budget = (SERVE_MAX_BATCH * per_row * 3 // 4 + 1) * \
+        block_bytes["f32"]
+    sides = {}
+    for dt in ("f32", kv_dtype):
+        n = max(int(budget // block_bytes[dt]), per_row + 2)
+        totals, _snap, occ = one_mode(True, n, kv_dtype=dt)
+        offered = totals["requests"] + totals["shed"]
+        sides[dt] = {
+            "kv_blocks": n,
+            "block_bytes": block_bytes[dt],
+            "pool_bytes": occ.get("bytes_total"),
+            "capacity_streams": (n - 1) // per_row,
+            "tok_per_sec": round(
+                totals["tokens"] / max(totals["wall"], 1e-9), 1),
+            "requests": totals["requests"],
+            "shed_429": totals["shed"],
+            "shed_rate": round(
+                totals["shed"] / max(offered, 1), 4),
+            "pool_peak_blocks": totals["pool_peak"],
+        }
+    print(json.dumps({
+        "metric": "serve_kv_quant_capacity_streams",
+        "value": sides[kv_dtype]["capacity_streams"],
+        "unit": "streams",
+        "vs_baseline": round(
+            sides[kv_dtype]["capacity_streams"] /
+            max(sides["f32"]["capacity_streams"], 1), 4),
+        "vs_baseline_meaning":
+            "streams_before_first_shed_vs_f32_at_fixed_byte_budget",
+        "kv_dtype": kv_dtype,
+        "streams": streams,
+        "seconds": seconds,
+        "budget_bytes": budget,
+        "worst_case_blocks_per_stream": per_row,
+        "f32": sides["f32"],
+        kv_dtype: sides[kv_dtype],
     }))
 
 
@@ -1429,6 +1500,61 @@ def optimizer_fields(wf, name):
     }
 
 
+def parse_net_dtype(argv):
+    for i, arg in enumerate(argv):
+        if arg.startswith("--net-dtype="):
+            return arg.split("=", 1)[1]
+        if arg == "--net-dtype" and i + 1 < len(argv):
+            return argv[i + 1]
+    return None
+
+
+def net_dtype_fields(wf, net_dtype):
+    """``--lm --net-dtype=DT`` A/B columns (BENCH_r13): the
+    worker→master delta wire bytes per minibatch at every codec
+    rung up to DT.  One real update message (``{"U": ..., "bv":
+    ...}``) is built from the LM's actual trainable arrays — a
+    delta has exactly a weight's shape — encoded through
+    ``encode_delta`` and framed by the PR-4 zero-copy tensor wire,
+    so the figure is wire truth (codes + scales + pickled
+    skeleton), not an nbytes estimate.  The acceptance bar is int8
+    ≤ ~half the bf16 bytes; the convergence and quality gates for
+    the lossy rungs live in tier-1 (tests/test_quant.py)."""
+    if not net_dtype:
+        return {}
+    import numpy
+    from veles_tpu.network_common import (DELTA_DTYPES,
+                                          encode_delta,
+                                          encode_tensor_parts)
+    if net_dtype not in DELTA_DTYPES:
+        raise SystemExit("--net-dtype %s: valid rungs are %s" %
+                         (net_dtype, ", ".join(DELTA_DTYPES)))
+    deltas = {}
+    for i, u in enumerate(wf.units):
+        get = getattr(u, "_trainable_arrays", None)
+        if get is None or not getattr(u, "trainables", None):
+            continue
+        for attr, arr in get().items():
+            a = numpy.ascontiguousarray(arr, dtype=numpy.float32)
+            deltas["%d.%s" % (i, attr)] = a
+    ladder = [n for n in DELTA_DTYPES
+              if n in ("fp32", "bf16") or n == net_dtype]
+    out = {"net_dtype": net_dtype}
+    for rung in ladder:
+        msg = {"U": {}, "bv": 0}
+        for name, a in deltas.items():
+            payload = encode_delta(a, rung, seed=1)
+            msg["U"][name] = a if payload is None else payload
+        parts = encode_tensor_parts(msg)
+        out["delta_bytes_per_minibatch_%s" % rung] = \
+            sum(len(p) for p in parts)
+    base = out.get("delta_bytes_per_minibatch_bf16")
+    mine = out.get("delta_bytes_per_minibatch_%s" % net_dtype)
+    if base and mine and net_dtype not in ("fp32", "bf16"):
+        out["delta_bytes_vs_bf16"] = round(mine / base, 4)
+    return out
+
+
 def parse_population(argv):
     """``--population[=N]`` / ``--population-members=N`` /
     ``--population-epochs=E`` / ``--population-ticks=K`` knobs for
@@ -1782,6 +1908,7 @@ def main():
         stages = parse_attn_stages(sys.argv)
         apply_attn_stages(stages)
         opt_name = parse_optimizer(sys.argv)
+        net_dtype = parse_net_dtype(sys.argv)
         # --moe-topk=K [--moe-experts=E]: the LM's blocks become
         # top-k MoE; router health rides the JSON line (moe_fields).
         moe_topk, moe_experts = parse_moe(sys.argv)
@@ -1845,6 +1972,7 @@ def main():
             **attribution_fields(),
             **optimizer_fields(wf, opt_name),
             **moe_fields(wf, moe_topk, moe_experts),
+            **net_dtype_fields(wf, net_dtype),
         }))
         return
     if "--mlp" in sys.argv:

@@ -39,7 +39,7 @@ CHECKED_PREFIXES = frozenset((
     "requests", "batches", "tokens", "rejected", "cancelled",
     "stalled", "warmup", "ttft", "itl", "perf", "optimizer", "moe",
     "spec", "drained", "population", "pbt", "fleet", "membership",
-    "fabric", "router", "tenant",
+    "fabric", "router", "tenant", "quant",
 ))
 
 

@@ -123,9 +123,10 @@ class PagedFakeModel(object):
         self.verify_shapes = []  # (B, K+1)
         self._lock = threading.Lock()
 
-    def make_kv_pool(self, n_blocks, block_size=16):
+    def make_kv_pool(self, n_blocks, block_size=16, kv_dtype="f32"):
         return KVBlockPool(n_blocks, block_size,
-                           copy_fn=lambda storage, s, d: storage)
+                           copy_fn=lambda storage, s, d: storage,
+                           kv_dtype=kv_dtype)
 
     def paged_extend(self, pool, tables, tokens, prior, chunk_lens,
                      temps, seeds):

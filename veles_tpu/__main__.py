@@ -309,6 +309,11 @@ class Main(Logger, CommandLineBase):
         if args.serve_kv_block_size is not None:
             root.common.serving.kv_block_size = \
                 args.serve_kv_block_size
+        if args.serve_kv_dtype is not None:
+            root.common.serving.kv_dtype = args.serve_kv_dtype
+        if args.serve_weight_dtype is not None:
+            root.common.serving.weight_dtype = \
+                args.serve_weight_dtype
         if args.serve_no_paged:
             root.common.serving.paged = False
         if args.serve_spec:

@@ -33,7 +33,7 @@ WORKER_CAPS = {
     "trace": True,         # span shipping + clock-sync timestamps
     "slots": True,         # ZeRO slot-shard sync (--net-zero)
     "codecs": ("none", "gzip"),
-    "dtypes": ("fp32", "bf16"),
+    "dtypes": ("fp32", "bf16", "int8"),
 }
 
 

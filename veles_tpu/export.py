@@ -327,6 +327,79 @@ def _pack_binary(manifest, weight_arrays):
 
 # -- paged KV cache: the block pool --------------------------------------
 
+#: Storage dtypes the paged KV pool supports.  "f32" is the exact
+#: path (byte-for-byte today's arithmetic — the bit-identical greedy
+#: anchor); "bf16" is a scale-free cast; "int8" and "fp8" carry
+#: per-(block, head) f32 scales alongside the block tensors and
+#: quantize on scatter / dequantize on gather (KIVI-style block
+#: granularity, so refcounts, COW, and prefix-cache sha1 keys never
+#: see the quantization — they only ever address whole blocks).
+KV_DTYPES = ("f32", "bf16", "int8", "fp8")
+
+#: Symmetric clip range per scaled storage dtype (None: scale-free).
+_KV_QMAX = {"f32": None, "bf16": None, "int8": 127.0, "fp8": 448.0}
+
+#: Bytes per stored k/v element.
+_KV_ITEMSIZE = {"f32": 4, "bf16": 2, "int8": 1, "fp8": 1}
+
+
+def kv_dtype_supported(kv_dtype):
+    """Whether this jax build can hold the storage dtype.  fp8 needs
+    ``jnp.float8_e4m3fn`` (capable platforms only); the int8 and bf16
+    planes work everywhere."""
+    if kv_dtype not in KV_DTYPES:
+        return False
+    if kv_dtype != "fp8":
+        return True
+    import jax.numpy as jnp
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def check_kv_dtype(kv_dtype):
+    """Canonical KV storage dtype name (None → "f32"), or Bug naming
+    the valid set for unknown/unsupported names."""
+    kv_dtype = "f32" if kv_dtype is None else str(kv_dtype)
+    if kv_dtype not in KV_DTYPES:
+        raise Bug("unknown KV storage dtype %r — valid: %s" %
+                  (kv_dtype, ", ".join(KV_DTYPES)))
+    if not kv_dtype_supported(kv_dtype):
+        raise Bug("KV storage dtype %r is not supported by this jax "
+                  "build (fp8 needs float8_e4m3fn)" % (kv_dtype,))
+    return kv_dtype
+
+
+def _kv_storage_jnp(kv_dtype):
+    import jax.numpy as jnp
+    return {"f32": jnp.float32, "bf16": jnp.bfloat16,
+            "int8": jnp.int8,
+            "fp8": getattr(jnp, "float8_e4m3fn", None)}[kv_dtype]
+
+
+def _kv_unpack(storage):
+    """``(ks, vs, sks, svs)`` from a pool storage tuple — the scale
+    lists are None for scale-free (f32/bf16) pools."""
+    if len(storage) == 4:
+        return storage
+    ks, vs = storage
+    return ks, vs, None, None
+
+
+def _kv_quantize(vals, scale_full, kv_dtype):
+    """Quantize f32 ``vals`` at an already-broadcast ``scale_full``
+    (zero scale → zero code, so never-written rows stay exact
+    zeros).  int8 rounds-to-nearest; fp8 clips then lets the cast
+    round — both deterministic, the paged parity gates replay
+    byte-identical sessions."""
+    import jax.numpy as jnp
+    qmax = _KV_QMAX[kv_dtype]
+    safe = jnp.where(scale_full > 0.0, scale_full, 1.0)
+    x = jnp.clip(vals / safe, -qmax, qmax)
+    if kv_dtype == "int8":
+        x = jnp.round(x)
+    return jnp.where(scale_full > 0.0, x,
+                     0.0).astype(_kv_storage_jnp(kv_dtype))
+
+
 class KVBlockPool(object):
     """A vLLM-style block pool for the paged serving decode path:
     the device holds one fixed tensor of ``(n_blocks, block_size, H,
@@ -356,7 +429,8 @@ class KVBlockPool(object):
     TRASH = 0
 
     def __init__(self, n_blocks, block_size, storage=None,
-                 copy_fn=None, prefix_capacity=256):
+                 copy_fn=None, prefix_capacity=256, kv_dtype=None,
+                 block_bytes=0):
         n_blocks = int(n_blocks)
         block_size = int(block_size)
         if n_blocks < 2:
@@ -368,6 +442,11 @@ class KVBlockPool(object):
         self.block_size = block_size
         self.storage = storage
         self._copy_fn = copy_fn
+        # Storage dtype + per-block device bytes (geometry × itemsize
+        # + scale rows): immutable after construction, so occupancy()
+        # reads them lock-free — only the COUNTS need the lock.
+        self.kv_dtype = check_kv_dtype(kv_dtype)
+        self.block_bytes = int(block_bytes)
         self.prefix_capacity = int(prefix_capacity)
         self._lock = SniffedLock(name="KVBlockPool.lock")
         # LIFO free list: recently-freed blocks are re-used first
@@ -614,13 +693,21 @@ class KVBlockPool(object):
 
     def occupancy(self):
         """The ``/stats`` pool section: block occupancy plus prefix-
-        cache and COW counters."""
+        cache and COW counters, and the BYTES the blocks occupy
+        (blocks × block geometry × storage dtype, scale rows
+        included) — the figure that makes a quantized pool's capacity
+        win visible on the dashboard."""
         with self._lock:
+            used = self.usable - len(self._free)
             return {
                 "block_size": self.block_size,
                 "blocks_total": self.usable,
                 "blocks_free": len(self._free),
-                "blocks_used": self.usable - len(self._free),
+                "blocks_used": used,
+                "storage_dtype": self.kv_dtype,
+                "block_bytes": self.block_bytes,
+                "bytes_total": self.usable * self.block_bytes,
+                "bytes_used": used * self.block_bytes,
                 "prefix_entries": len(self._prefix),
                 "prefix_hits": self.prefix_hits,
                 "prefix_misses": self.prefix_misses,
@@ -635,9 +722,27 @@ class KVBlockPool(object):
 # but not another would silently break the documented bit-identical
 # greedy guarantee between the two paths.
 
-def _head_logits(x_last, head_w, head_b):
-    y = x_last @ head_w
+def _head_logits(x_last, head_w, head_b, head_s=None):
+    if head_s is not None:
+        # Weight-only int8 head: dequant-in-kernel — the int8 weight
+        # feeds the dot directly and the per-output-channel scale
+        # applies to the f32 accumulator (LLM.int8-style).
+        y = (x_last @ head_w.astype(head_s.dtype)) * head_s
+    else:
+        y = x_last @ head_w
     return y + head_b if head_b is not None else y
+
+
+def _mm(h, p, name):
+    """``h @ W`` for a decode-program weight: when the parameter
+    pytree carries a ``<name>__s`` per-output-channel scale (the
+    weight-only int8 plane), the int8 weight feeds the dot and the
+    scale applies to the accumulator — dequant-in-kernel, never a
+    materialized f32 copy of the weight."""
+    s = p.get(name + "__s")
+    if s is None:
+        return h @ p[name]
+    return (h @ p[name].astype(s.dtype)) * s
 
 
 def _sample_rows(logits, keys, temps):
@@ -742,14 +847,56 @@ class ExportedModel(object):
                                  for k, v in self.weights.items()}
         return self._jax_weights
 
+    @staticmethod
+    def _decode_weight_mode():
+        """The weight plane of the decode program family:
+        ``root.common.serving.weight_dtype`` — "f32" (default, the
+        parity anchor) or "int8" (weight-only int8 matmuls with
+        per-output-channel scales, dequant-in-kernel).  The dense
+        ``forward`` path never quantizes — it stays the f32 oracle
+        the perplexity-delta gate compares against.  The mode string
+        rides every decode compile-cache key like ``attend=`` does."""
+        from .config import root, get as config_get
+        mode = str(config_get(root.common.serving.weight_dtype,
+                              "f32"))
+        if mode not in ("f32", "int8"):
+            raise Bug("unknown decode weight dtype %r — valid: "
+                      "f32, int8" % (mode,))
+        return mode
+
+    #: 2-D decode matmul weights that ride the weight-only int8 plane
+    #: (embeddings are gathers, norms/biases stay f32).
+    _WQ_NAMES = ("wq", "wk", "wv", "wqkv", "wo", "w1", "w2")
+
+    @staticmethod
+    def _quantize_weight(d, name):
+        """Per-output-channel symmetric int8: ``W ≈ Q · s`` with
+        ``s = amax(|W|, axis=0) / 127`` — stored as ``<name>`` (int8)
+        plus ``<name>__s`` (f32 row vector).  Zero columns quantize
+        to zero codes with zero scale, an exact round trip."""
+        import jax.numpy as jnp
+        w = d.get(name)
+        if w is None or w.ndim != 2:
+            return
+        s = jnp.max(jnp.abs(w), axis=0) / 127.0
+        safe = jnp.where(s > 0.0, s, 1.0)
+        d[name] = jnp.clip(jnp.round(w / safe), -127,
+                           127).astype(jnp.int8)
+        d[name + "__s"] = s
+
     def _lm_params(self):
         """The LM decode-program parameter pytree (embedding, head,
         per-block dicts), built from :meth:`_device_weights` and
-        invalidated with it on :meth:`swap_weights`."""
-        if self._lm_params_cache is None:
+        invalidated with it on :meth:`swap_weights` — which is why a
+        hot swap re-quantizes automatically: the swapped weights
+        rebuild this cache (on the device thread, where every decode
+        program runs) under the current weight mode."""
+        mode = self._decode_weight_mode()
+        cached = self._lm_params_cache
+        if cached is None or cached[0] != mode:
             emb, blocks, head = self._lm_chain()
             dev = self._device_weights()
-            self._lm_params_cache = {
+            params = {
                 "emb_w": dev[emb["params"]["weights"]],
                 "emb_pos": dev[emb["params"]["pos"]],
                 "head_w": dev[head["params"]["weights"]],
@@ -758,7 +905,13 @@ class ExportedModel(object):
                 "blocks": [{n: dev[e["params"][n]]
                             for n in e["params"]} for e in blocks],
             }
-        return self._lm_params_cache
+            if mode == "int8":
+                for bp in params["blocks"]:
+                    for name in self._WQ_NAMES:
+                        self._quantize_weight(bp, name)
+                self._quantize_weight(params, "head_w")
+            self._lm_params_cache = (mode, params)
+        return self._lm_params_cache[1]
 
     def geometry_of(self):
         """The swap-compatibility fingerprint: the unit table plus
@@ -1148,7 +1301,7 @@ class ExportedModel(object):
         from .ops import pallas_attention as PA
         interpret = mode == "interpret"
 
-        def attend(q, kc, vc, key_mask):
+        def attend(q, kc, vc, key_mask, k_scale=None, v_scale=None):
             if not PA.supports_decode(q.shape, kc.shape,
                                       interpret=interpret):
                 return None
@@ -1156,10 +1309,14 @@ class ExportedModel(object):
                 return None
             # f32 operands: the serving surfaces promise f32 math —
             # the kernel changes the REDUCTION ORDER only, which the
-            # token-identity gate covers.
+            # token-identity gate covers.  On a quantized pool the
+            # k/v arrive as stored codes plus per-position scales and
+            # the DEQUANT HAPPENS INSIDE THE KERNEL's k/v gather —
+            # the dequantized cache is never materialized in HBM.
             return PA.pallas_decode_attention(
                 q, kc, vc, key_mask, operand_dtype=jnp.float32,
-                interpret=interpret)
+                interpret=interpret, k_scale=k_scale,
+                v_scale=v_scale)
 
         return attend
 
@@ -1348,12 +1505,13 @@ class ExportedModel(object):
         if "wqkv" in p:
             # Fused-QKV artifact: same head-major (E, 3E) layout as
             # the training/serving forward paths.
-            qkv = (h @ p["wqkv"] + p["bqkv"]).reshape(B, S_, H, 3, D)
+            qkv = (_mm(h, p, "wqkv") +
+                   p["bqkv"]).reshape(B, S_, H, 3, D)
             q, kn, vn = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         else:
-            q = (h @ p["wq"] + p["bq"]).reshape(B, S_, H, D)
-            kn = (h @ p["wk"] + p["bk"]).reshape(B, S_, H, D)
-            vn = (h @ p["wv"] + p["bv"]).reshape(B, S_, H, D)
+            q = (_mm(h, p, "wq") + p["bq"]).reshape(B, S_, H, D)
+            kn = (_mm(h, p, "wk") + p["bk"]).reshape(B, S_, H, D)
+            vn = (_mm(h, p, "wv") + p["bv"]).reshape(B, S_, H, D)
         ck = lax.dynamic_update_slice(ck, kn, (0, start, 0, 0))
         cv = lax.dynamic_update_slice(cv, vn, (0, start, 0, 0))
         if key_mask is None:
@@ -1372,10 +1530,10 @@ class ExportedModel(object):
             scores = jnp.where(kmask[:, :, None, :], scores, -1e30)
             w = jax.nn.softmax(scores, axis=-1)
             attn = jnp.einsum("bqhk,bkhd->bqhd", w, cv)
-        x = x + attn.reshape(B, S_, E) @ p["wo"] + p["bo"]
+        x = x + _mm(attn.reshape(B, S_, E), p, "wo") + p["bo"]
         h = ln(x, p["ln2_g"], p["ln2_b"])
-        x = x + jnp.maximum(h @ p["w1"] + p["b1"], 0.0) @ p["w2"] \
-            + p["b2"]
+        x = x + _mm(jnp.maximum(_mm(h, p, "w1") + p["b1"], 0.0),
+                    p, "w2") + p["b2"]
         return x.astype(jnp.float32), ck, cv
 
     def _build_generate(self, S0, max_new):
@@ -1407,7 +1565,8 @@ class ExportedModel(object):
 
         def logits_of(params, x_last):
             return _head_logits(x_last, params["head_w"],
-                                params["head_b"])
+                                params["head_b"],
+                                params.get("head_w__s"))
 
         def sample(logits, key, temperature):
             """Greedy/temperature select with temperature as a TRACED
@@ -1530,7 +1689,8 @@ class ExportedModel(object):
         # through the serving endpoint, so it must not grow without
         # bound.
         fn = self.compile_cache.get_or_build(
-            ("gen", S0, max_new, self._decode_kernel_mode()),
+            ("gen", S0, max_new, self._decode_weight_mode(),
+             self._decode_kernel_mode()),
             lambda: self._build_generate(S0, max_new))
         tokens, logits = fn(self._lm_params(), prompt,
                             jax.random.PRNGKey(seed),
@@ -1577,7 +1737,8 @@ class ExportedModel(object):
 
         def logits_of(params, x_last):
             return _head_logits(x_last, params["head_w"],
-                                params["head_b"])
+                                params["head_b"],
+                                params.get("head_w__s"))
 
         sample_rows = _sample_rows
         att = self._decode_attend()
@@ -1687,7 +1848,8 @@ class ExportedModel(object):
                 "prompt of %d tokens exceeds the model's positional "
                 "table (%d)" % (max(S0b, int(lengths.max())), limit))
         fn = self.compile_cache.get_or_build(
-            ("genb", B, S0b, max_new, self._decode_kernel_mode()),
+            ("genb", B, S0b, max_new, self._decode_weight_mode(),
+             self._decode_kernel_mode()),
             lambda: self._build_generate_bucketed(S0b, max_new))
         return numpy.asarray(fn(self._lm_params(), prompts, lengths,
                                 seeds, temps))
@@ -1710,65 +1872,112 @@ class ExportedModel(object):
                       (E, H))
         return len(blocks), H, E // H
 
-    def make_kv_pool(self, n_blocks, block_size=16):
+    def make_kv_pool(self, n_blocks, block_size=16, kv_dtype=None):
         """A :class:`KVBlockPool` backed by per-layer device tensors
         of ``(n_blocks, block_size, H, D)`` k/v blocks — the paged
         substrate the serving engine's decode-step batching runs on.
-        Raises Bug when the artifact is not a causal LM."""
+        ``kv_dtype`` picks the storage plane (default: the
+        ``root.common.serving.kv_dtype`` config, "f32"): "f32" is
+        byte-for-byte today's exact path, "bf16" a scale-free cast,
+        "int8"/"fp8" carry per-(block, head) f32 scale tensors
+        alongside the blocks and quantize on scatter / dequantize on
+        gather.  Raises Bug when the artifact is not a causal LM."""
         import jax.numpy as jnp
+        from .config import root, get as config_get
+        if kv_dtype is None:
+            kv_dtype = config_get(root.common.serving.kv_dtype,
+                                  "f32")
+        kv_dtype = check_kv_dtype(kv_dtype)
         L, H, D = self._paged_geometry()
-        ks = [jnp.zeros((int(n_blocks), int(block_size), H, D),
-                        jnp.float32) for _ in range(L)]
-        vs = [jnp.zeros((int(n_blocks), int(block_size), H, D),
-                        jnp.float32) for _ in range(L)]
-        return KVBlockPool(n_blocks, block_size, storage=(ks, vs),
-                           copy_fn=self._kv_copy_block)
+        n, bs = int(n_blocks), int(block_size)
+        sd = _kv_storage_jnp(kv_dtype)
+        ks = [jnp.zeros((n, bs, H, D), sd) for _ in range(L)]
+        vs = [jnp.zeros((n, bs, H, D), sd) for _ in range(L)]
+        block_bytes = 2 * L * bs * H * D * _KV_ITEMSIZE[kv_dtype]
+        if _KV_QMAX[kv_dtype] is not None:
+            sks = [jnp.zeros((n, H), jnp.float32) for _ in range(L)]
+            svs = [jnp.zeros((n, H), jnp.float32) for _ in range(L)]
+            storage = (ks, vs, sks, svs)
+            block_bytes += 2 * L * H * 4
+        else:
+            storage = (ks, vs)
+        return KVBlockPool(n_blocks, block_size, storage=storage,
+                           copy_fn=self._kv_copy_block,
+                           kv_dtype=kv_dtype,
+                           block_bytes=block_bytes)
 
     def _kv_copy_block(self, storage, src, dst):
         """Device-side block copy for the pool's copy-on-write (one
         jitted program per pool geometry; src/dst are traced, so
-        every copy rides the same executable)."""
+        every copy rides the same executable).  On a quantized pool
+        the per-(block, head) scale rows copy WITH the codes — the
+        copy is bit-exact, so a COW'd block dequantizes to exactly
+        the shared original's values."""
         import jax
-        ks, vs = storage
-        key = ("pcopy", ks[0].shape[0], ks[0].shape[1], len(ks))
+        ks, vs, sks, svs = _kv_unpack(storage)
+        key = ("pcopy", ks[0].shape[0], ks[0].shape[1], len(ks),
+               sks is not None)
 
         def build():
-            def run(ks, vs, src, dst):
+            if sks is None:
+                def run(ks, vs, src, dst):
+                    ks = [k.at[dst].set(k[src]) for k in ks]
+                    vs = [v.at[dst].set(v[src]) for v in vs]
+                    return ks, vs
+                return jax.jit(run, donate_argnums=(0, 1))
+
+            def run(ks, vs, sks, svs, src, dst):
                 ks = [k.at[dst].set(k[src]) for k in ks]
                 vs = [v.at[dst].set(v[src]) for v in vs]
-                return ks, vs
-            return jax.jit(run, donate_argnums=(0, 1))
+                sks = [s.at[dst].set(s[src]) for s in sks]
+                svs = [s.at[dst].set(s[src]) for s in svs]
+                return ks, vs, sks, svs
+            return jax.jit(run, donate_argnums=(0, 1, 2, 3))
 
         fn = self.compile_cache.get_or_build(key, build)
         src_dst = jax.device_put((numpy.int32(src),
                                   numpy.int32(dst)))
-        return fn(ks, vs, *src_dst)
+        if sks is None:
+            return fn(ks, vs, *src_dst)
+        return fn(ks, vs, sks, svs, *src_dst)
 
     def export_kv_blocks(self, pool, ids):
         """The addressed pool blocks as ONE host array ``(L, 2, n,
         block_size, H, D)`` f32 (k then v per layer) — the tensor the
         disaggregation wire ships (``serving.fabric.disagg`` frames
-        it zero-copy via ``encode_tensor_parts``).  The caller holds
-        refs on ``ids`` (``export_prefix_blocks``) so the device rows
-        cannot be reused mid-read."""
+        it zero-copy via ``encode_tensor_parts``).  Quantized pools
+        DEQUANTIZE on export, so the wire format is
+        storage-dtype-agnostic: an int8 prefill worker can feed an
+        f32 decode replica and vice versa.  The caller holds refs on
+        ``ids`` (``export_prefix_blocks``) so the device rows cannot
+        be reused mid-read."""
+        import jax.numpy as jnp
         idx = numpy.asarray(list(ids), dtype=numpy.int32)
-        ks, vs = pool.storage
-        return numpy.stack(
-            [numpy.stack([numpy.asarray(k[idx]),
-                          numpy.asarray(v[idx])])
-             for k, v in zip(ks, vs)])
+        ks, vs, sks, svs = _kv_unpack(pool.storage)
+        out = []
+        for i, (k, v) in enumerate(zip(ks, vs)):
+            kb = k[idx].astype(jnp.float32)
+            vb = v[idx].astype(jnp.float32)
+            if sks is not None:
+                kb = kb * sks[i][idx][:, None, :, None]
+                vb = vb * svs[i][idx][:, None, :, None]
+            out.append(numpy.stack([numpy.asarray(kb),
+                                    numpy.asarray(vb)]))
+        return numpy.stack(out)
 
     def import_kv_blocks(self, pool, ids, blocks):
         """Scatters a shipped ``(L, 2, n, block_size, H, D)`` host
         array (from :meth:`export_kv_blocks` on the peer) into THIS
-        pool's storage at ``ids``.  Produces new per-layer device
-        tensors functionally, exactly like the COW copy — callers on
-        the serving path route through the engine's device-thread op
+        pool's storage at ``ids`` — re-quantizing with fresh
+        per-(block, head) scales when this pool is int8/fp8 (the
+        wire is always f32).  Produces new per-layer device tensors
+        functionally, exactly like the COW copy — callers on the
+        serving path route through the engine's device-thread op
         queue so the write never races a donated decode step."""
         import jax.numpy as jnp
         blocks = numpy.asarray(blocks, dtype=numpy.float32)
         idx = jnp.asarray(list(ids), dtype=jnp.int32)
-        ks, vs = pool.storage
+        ks, vs, sks, svs = _kv_unpack(pool.storage)
         L = len(ks)
         if blocks.shape[:2] != (L, 2) or \
                 blocks.shape[2] != len(ids) or \
@@ -1776,14 +1985,35 @@ class ExportedModel(object):
             raise Bug("imported KV block shape %s does not match "
                       "pool geometry (L=%d, block=%s, n=%d)" %
                       (blocks.shape, L, ks[0].shape[1:], len(ids)))
-        ks = [k.at[idx].set(jnp.asarray(blocks[i, 0]))
-              for i, k in enumerate(ks)]
-        vs = [v.at[idx].set(jnp.asarray(blocks[i, 1]))
-              for i, v in enumerate(vs)]
-        pool.storage = (ks, vs)
+        if sks is None:
+            ks = [k.at[idx].set(
+                jnp.asarray(blocks[i, 0]).astype(k.dtype))
+                for i, k in enumerate(ks)]
+            vs = [v.at[idx].set(
+                jnp.asarray(blocks[i, 1]).astype(v.dtype))
+                for i, v in enumerate(vs)]
+            pool.storage = (ks, vs)
+            return
+        qmax = _KV_QMAX[pool.kv_dtype]
+        new_ks, new_vs, new_sks, new_svs = [], [], [], []
+        for i in range(L):
+            kb = jnp.asarray(blocks[i, 0])  # (n, bs, H, D)
+            vb = jnp.asarray(blocks[i, 1])
+            sk = jnp.max(jnp.abs(kb), axis=(1, 3)) / qmax  # (n, H)
+            sv = jnp.max(jnp.abs(vb), axis=(1, 3)) / qmax
+            qk = _kv_quantize(kb, sk[:, None, :, None],
+                              pool.kv_dtype)
+            qv = _kv_quantize(vb, sv[:, None, :, None],
+                              pool.kv_dtype)
+            new_ks.append(ks[i].at[idx].set(qk))
+            new_vs.append(vs[i].at[idx].set(qv))
+            new_sks.append(sks[i].at[idx].set(sk))
+            new_svs.append(svs[i].at[idx].set(sv))
+        pool.storage = (new_ks, new_vs, new_sks, new_svs)
 
     def _paged_block(self, p, x, pk, pv, tables, wblock, wslot,
-                     key_mask, n_heads, attend=None):
+                     key_mask, n_heads, attend=None, sk=None,
+                     sv=None, kv_dtype="f32"):
         """One pre-LN block against the POOLED cache: the chunk's
         k/v scatter to ``(wblock, wslot)`` (physical block, in-block
         slot — per row AND per chunk position, so rows at different
@@ -1794,7 +2024,20 @@ class ExportedModel(object):
         softmax and real keys keep their relative order, so paged
         greedy decode is bit-identical to the dense cached path.
         ``attend`` is the flag-gated flash-decode hook, exactly as
-        in :meth:`_cached_block` (same mask, same zeros)."""
+        in :meth:`_cached_block` (same mask, same zeros).
+
+        QUANTIZED pools (``sk``/``sv``: per-(block, head) f32 scale
+        tensors): the quantize happens INSIDE this scatter — the
+        written blocks' scales grow monotonically (scatter-max over
+        the chunk's |k|,|v| amax), only the written blocks get their
+        stored codes rescaled by old/new (an untouched block's ratio
+        is EXACTLY 1.0, an exact code round trip — which is why a
+        shared prefix block, never written by a reader, stays
+        bit-stable under COW/refcount semantics), and the chunk's
+        values quantize at the grown scale.  The gather dequantizes:
+        either inside the flash-decode kernel (codes + per-position
+        scales feed ``attend``) or as ``codes·scale`` for the dense
+        fallback einsum."""
         import jax
         import jax.numpy as jnp
 
@@ -1809,19 +2052,80 @@ class ExportedModel(object):
         D = E // H
         h = ln(x, p["ln1_g"], p["ln1_b"])
         if "wqkv" in p:
-            qkv = (h @ p["wqkv"] + p["bqkv"]).reshape(B, S_, H, 3, D)
+            qkv = (_mm(h, p, "wqkv") +
+                   p["bqkv"]).reshape(B, S_, H, 3, D)
             q, kn, vn = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         else:
-            q = (h @ p["wq"] + p["bq"]).reshape(B, S_, H, D)
-            kn = (h @ p["wk"] + p["bk"]).reshape(B, S_, H, D)
-            vn = (h @ p["wv"] + p["bv"]).reshape(B, S_, H, D)
-        pk = pk.at[wblock, wslot].set(kn)
-        pv = pv.at[wblock, wslot].set(vn)
-        kc = pk[tables].reshape(B, -1, H, D)
-        vc = pv[tables].reshape(B, -1, H, D)
-        attn = attend(q, kc, vc, key_mask) if attend is not None \
+            q = (_mm(h, p, "wq") + p["bq"]).reshape(B, S_, H, D)
+            kn = (_mm(h, p, "wk") + p["bk"]).reshape(B, S_, H, D)
+            vn = (_mm(h, p, "wv") + p["bv"]).reshape(B, S_, H, D)
+        T = tables.shape[1]
+        bs = pk.shape[1]
+        k_scale = v_scale = None
+        if sk is None:
+            if pk.dtype == jnp.float32:
+                # The exact plane: byte-for-byte the original path.
+                pk = pk.at[wblock, wslot].set(kn)
+                pv = pv.at[wblock, wslot].set(vn)
+                kc = pk[tables].reshape(B, -1, H, D)
+                vc = pv[tables].reshape(B, -1, H, D)
+            else:
+                # Scale-free cast storage (bf16).
+                pk = pk.at[wblock, wslot].set(kn.astype(pk.dtype))
+                pv = pv.at[wblock, wslot].set(vn.astype(pv.dtype))
+                kc = pk[tables].astype(jnp.float32) \
+                    .reshape(B, -1, H, D)
+                vc = pv[tables].astype(jnp.float32) \
+                    .reshape(B, -1, H, D)
+        else:
+            qmax = _KV_QMAX[kv_dtype]
+            # 1. Grow the written blocks' scales (scatter-max; all
+            #    pad writes land on the trash block, whose content
+            #    is junk by contract).
+            amax_k = jnp.max(jnp.abs(kn), axis=-1) / qmax  # (B,S_,H)
+            amax_v = jnp.max(jnp.abs(vn), axis=-1) / qmax
+            sk_new = sk.at[wblock].max(amax_k)
+            sv_new = sv.at[wblock].max(amax_v)
+            # 2. Rescale ONLY the written blocks' existing codes by
+            #    old/new.  Duplicate wblock entries (chunk positions
+            #    in one block) write identical rescaled rows, so the
+            #    scatter collision is benign.
+            rk = sk / jnp.where(sk_new > 0.0, sk_new, 1.0)
+            rv = sv / jnp.where(sv_new > 0.0, sv_new, 1.0)
+            old_k = pk[wblock].astype(jnp.float32) * \
+                rk[wblock][:, :, None, :, None]
+            old_v = pv[wblock].astype(jnp.float32) * \
+                rv[wblock][:, :, None, :, None]
+            if kv_dtype == "int8":
+                old_k = jnp.round(old_k)
+                old_v = jnp.round(old_v)
+            pk = pk.at[wblock].set(old_k.astype(pk.dtype))
+            pv = pv.at[wblock].set(old_v.astype(pv.dtype))
+            # 3. Quantize the chunk's k/v at the grown scale and
+            #    scatter the codes.
+            pk = pk.at[wblock, wslot].set(_kv_quantize(
+                kn, sk_new[wblock][..., None], kv_dtype))
+            pv = pv.at[wblock, wslot].set(_kv_quantize(
+                vn, sv_new[wblock][..., None], kv_dtype))
+            sk, sv = sk_new, sv_new
+            # 4. Gather codes + per-position scales; the dequant
+            #    rides the attend kernel when it engages, else the
+            #    dense fallback below.
+            kc = pk[tables].reshape(B, -1, H, D)
+            vc = pv[tables].reshape(B, -1, H, D)
+            k_scale = jnp.broadcast_to(
+                sk[tables][:, :, None, :],
+                (B, T, bs, H)).reshape(B, -1, H)
+            v_scale = jnp.broadcast_to(
+                sv[tables][:, :, None, :],
+                (B, T, bs, H)).reshape(B, -1, H)
+        attn = attend(q, kc, vc, key_mask, k_scale=k_scale,
+                      v_scale=v_scale) if attend is not None \
             else None
         if attn is None:
+            if k_scale is not None:
+                kc = kc.astype(jnp.float32) * k_scale[..., None]
+                vc = vc.astype(jnp.float32) * v_scale[..., None]
             scores = jnp.einsum(
                 "bqhd,bkhd->bqhk", q, kc,
                 preferred_element_type=jnp.float32) / (D ** 0.5)
@@ -1829,11 +2133,11 @@ class ExportedModel(object):
                                -1e30)
             w = jax.nn.softmax(scores, axis=-1)
             attn = jnp.einsum("bqhk,bkhd->bqhd", w, vc)
-        x = x + attn.reshape(B, S_, E) @ p["wo"] + p["bo"]
+        x = x + _mm(attn.reshape(B, S_, E), p, "wo") + p["bo"]
         h = ln(x, p["ln2_g"], p["ln2_b"])
-        x = x + jnp.maximum(h @ p["w1"] + p["b1"], 0.0) @ p["w2"] \
-            + p["b2"]
-        return x.astype(jnp.float32), pk, pv
+        x = x + _mm(jnp.maximum(_mm(h, p, "w1") + p["b1"], 0.0),
+                    p, "w2") + p["b2"]
+        return x.astype(jnp.float32), pk, pv, sk, sv
 
     def _paged_lm_static(self):
         """Static geometry of the paged programs: (n_heads per block,
@@ -1845,7 +2149,18 @@ class ExportedModel(object):
         V = int(self.weights[emb["params"]["weights"]].shape[0])
         return n_heads, P, V
 
-    def _build_paged_extend(self, Sc, T, block_size):
+    @staticmethod
+    def _paged_storage_args(pool):
+        """The storage leaves of a pool as jitted-program positional
+        args, plus whether the pool is scaled-quantized — the shared
+        unpack of every paged entry point."""
+        ks, vs, sks, svs = _kv_unpack(pool.storage)
+        if sks is None:
+            return (ks, vs), False
+        return (ks, vs, sks, svs), True
+
+    def _build_paged_extend(self, Sc, T, block_size,
+                            kv_dtype="f32"):
         """Jitted chunk prefill/extension against the block pool:
         each row's ``chunk_len`` real tokens (right-padded to the
         ``Sc`` bucket) are embedded at logical positions ``prior +
@@ -1865,13 +2180,15 @@ class ExportedModel(object):
 
         def logits_of(params, x_last):
             return _head_logits(x_last, params["head_w"],
-                                params["head_b"])
+                                params["head_b"],
+                                params.get("head_w__s"))
 
         sample_rows = _sample_rows
         att = self._decode_attend()
+        quantized = _KV_QMAX[kv_dtype] is not None
 
-        def run(params, pks, pvs, tables, tokens, prior, chunk_len,
-                temps, seeds):
+        def run(params, pks, pvs, sks, svs, tables, tokens, prior,
+                chunk_len, temps, seeds):
             B = tables.shape[0]
             keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
             offs = jnp.arange(Sc)
@@ -1896,25 +2213,29 @@ class ExportedModel(object):
             qpos = prior[:, None] + offs[None, :]
             key_mask = (jnp.arange(S_keys)[None, None, :] <=
                         qpos[:, :, None])
-            new_pks, new_pvs = [], []
-            for pk, pv, p, H in zip(pks, pvs, params["blocks"],
-                                    n_heads):
-                x, pk, pv = self._paged_block(
+            new_pks, new_pvs, new_sks, new_svs = [], [], [], []
+            for i, (pk, pv, p, H) in enumerate(
+                    zip(pks, pvs, params["blocks"], n_heads)):
+                x, pk, pv, sk, sv = self._paged_block(
                     p, x, pk, pv, tables, wblock, wslot, key_mask, H,
-                    attend=att)
+                    attend=att, sk=sks[i] if quantized else None,
+                    sv=svs[i] if quantized else None,
+                    kv_dtype=kv_dtype)
                 new_pks.append(pk)
                 new_pvs.append(pv)
+                new_sks.append(sk)
+                new_svs.append(sv)
             idx = jnp.clip(chunk_len - 1, 0, Sc - 1)
             first_logits = logits_of(params, x[jnp.arange(B), idx])
             tok0 = sample_rows(
                 first_logits,
                 jax.vmap(lambda k: jax.random.fold_in(k, 0))(keys0),
                 temps)
-            return new_pks, new_pvs, tok0
+            return new_pks, new_pvs, new_sks, new_svs, tok0
 
-        return jax.jit(run, donate_argnums=(1, 2))
+        return jax.jit(run, donate_argnums=(1, 2, 3, 4))
 
-    def _build_paged_step(self, T, block_size):
+    def _build_paged_step(self, T, block_size, kv_dtype="f32"):
         """Jitted one-token decode step over the block pool: each
         row feeds its previous token at position ``pos`` (k/v
         scattered to table block ``pos // bs``, slot ``pos % bs``),
@@ -1931,13 +2252,15 @@ class ExportedModel(object):
 
         def logits_of(params, x_last):
             return _head_logits(x_last, params["head_w"],
-                                params["head_b"])
+                                params["head_b"],
+                                params.get("head_w__s"))
 
         sample_rows = _sample_rows
         att = self._decode_attend()
+        quantized = _KV_QMAX[kv_dtype] is not None
 
-        def run(params, pks, pvs, tables, pos, tok, gen_idx, temps,
-                seeds):
+        def run(params, pks, pvs, sks, svs, tables, pos, tok,
+                gen_idx, temps, seeds):
             keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
             posn = jnp.clip(pos, 0, P - 1)
             x = params["emb_w"][jnp.clip(tok, 0, V - 1)][:, None] + \
@@ -1948,23 +2271,28 @@ class ExportedModel(object):
             wslot = (wpos % bs)[:, None]
             key_mask = (jnp.arange(S_keys)[None, None, :] <=
                         pos[:, None, None])
-            new_pks, new_pvs = [], []
-            for pk, pv, p, H in zip(pks, pvs, params["blocks"],
-                                    n_heads):
-                x, pk, pv = self._paged_block(
+            new_pks, new_pvs, new_sks, new_svs = [], [], [], []
+            for i, (pk, pv, p, H) in enumerate(
+                    zip(pks, pvs, params["blocks"], n_heads)):
+                x, pk, pv, sk, sv = self._paged_block(
                     p, x, pk, pv, tables, wblock, wslot, key_mask, H,
-                    attend=att)
+                    attend=att, sk=sks[i] if quantized else None,
+                    sv=svs[i] if quantized else None,
+                    kv_dtype=kv_dtype)
                 new_pks.append(pk)
                 new_pvs.append(pv)
+                new_sks.append(sk)
+                new_svs.append(sv)
             logits = logits_of(params, x[:, 0])
             tok_new = sample_rows(
                 logits, jax.vmap(jax.random.fold_in)(keys0, gen_idx),
                 temps)
-            return new_pks, new_pvs, tok_new
+            return new_pks, new_pvs, new_sks, new_svs, tok_new
 
-        return jax.jit(run, donate_argnums=(1, 2))
+        return jax.jit(run, donate_argnums=(1, 2, 3, 4))
 
-    def _build_paged_verify(self, K, T, block_size):
+    def _build_paged_verify(self, K, T, block_size,
+                            kv_dtype="f32"):
         """Jitted speculative-verify step over the block pool: each
         row feeds its current token PLUS ``K`` draft tokens as one
         ``K+1``-position chunk at positions ``pos..pos+K`` (k/v
@@ -1997,13 +2325,15 @@ class ExportedModel(object):
 
         def logits_of(params, x_last):
             return _head_logits(x_last, params["head_w"],
-                                params["head_b"])
+                                params["head_b"],
+                                params.get("head_w__s"))
 
         sample_rows = _sample_rows
         att = self._decode_attend()
+        quantized = _KV_QMAX[kv_dtype] is not None
 
-        def run(params, pks, pvs, tables, pos, toks, dlens, gen_idx,
-                temps, seeds):
+        def run(params, pks, pvs, sks, svs, tables, pos, toks,
+                dlens, gen_idx, temps, seeds):
             keys0 = jax.vmap(jax.random.PRNGKey)(seeds)
             offs = jnp.arange(Sq)
             posn = jnp.clip(pos[:, None] + offs[None, :], 0, P - 1)
@@ -2022,14 +2352,18 @@ class ExportedModel(object):
             qpos = pos[:, None] + offs[None, :]
             key_mask = (jnp.arange(S_keys)[None, None, :] <=
                         qpos[:, :, None])
-            new_pks, new_pvs = [], []
-            for pk, pv, p, H in zip(pks, pvs, params["blocks"],
-                                    n_heads):
-                x, pk, pv = self._paged_block(
+            new_pks, new_pvs, new_sks, new_svs = [], [], [], []
+            for i, (pk, pv, p, H) in enumerate(
+                    zip(pks, pvs, params["blocks"], n_heads)):
+                x, pk, pv, sk, sv = self._paged_block(
                     p, x, pk, pv, tables, wblock, wslot, key_mask, H,
-                    attend=att)
+                    attend=att, sk=sks[i] if quantized else None,
+                    sv=svs[i] if quantized else None,
+                    kv_dtype=kv_dtype)
                 new_pks.append(pk)
                 new_pvs.append(pv)
+                new_sks.append(sk)
+                new_svs.append(sv)
             logits = logits_of(params, x)  # (B, Sq, V)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -2050,9 +2384,9 @@ class ExportedModel(object):
 
             out = lax.cond(jnp.any(temps > 0.0), drawn,
                            lambda _: greedy, None)
-            return new_pks, new_pvs, out
+            return new_pks, new_pvs, new_sks, new_svs, out
 
-        return jax.jit(run, donate_argnums=(1, 2))
+        return jax.jit(run, donate_argnums=(1, 2, 3, 4))
 
     def paged_verify(self, pool, tables, pos, toks, draft_lens,
                      gen_idx, temps, seeds):
@@ -2072,10 +2406,12 @@ class ExportedModel(object):
         Sq = toks.shape[1]
         fn = self.compile_cache.get_or_build(
             ("pver", B, Sq, T, pool.n_blocks, pool.block_size,
+             pool.kv_dtype, self._decode_weight_mode(),
              self._decode_kernel_mode()),
             lambda: self._build_paged_verify(Sq - 1, T,
-                                             pool.block_size))
-        ks, vs = pool.storage
+                                             pool.block_size,
+                                             pool.kv_dtype))
+        store, quantized = self._paged_storage_args(pool)
         # Explicit upload — see paged_extend (strict_step contract).
         args = jax.device_put((
             tables,
@@ -2085,8 +2421,11 @@ class ExportedModel(object):
             numpy.ascontiguousarray(gen_idx, dtype=numpy.int32),
             numpy.ascontiguousarray(temps, dtype=numpy.float32),
             numpy.ascontiguousarray(seeds, dtype=numpy.uint32)))
-        ks, vs, out = fn(self._lm_params(), ks, vs, *args)
-        pool.storage = (ks, vs)
+        ks, vs, sks, svs, out = fn(
+            self._lm_params(), store[0], store[1],
+            store[2] if quantized else None,
+            store[3] if quantized else None, *args)
+        pool.storage = (ks, vs, sks, svs) if quantized else (ks, vs)
         return numpy.asarray(out)
 
     def paged_extend(self, pool, tables, tokens, prior, chunk_lens,
@@ -2107,9 +2446,11 @@ class ExportedModel(object):
         Sc = tokens.shape[1]
         fn = self.compile_cache.get_or_build(
             ("pext", B, Sc, T, pool.n_blocks, pool.block_size,
+             pool.kv_dtype, self._decode_weight_mode(),
              self._decode_kernel_mode()),
-            lambda: self._build_paged_extend(Sc, T, pool.block_size))
-        ks, vs = pool.storage
+            lambda: self._build_paged_extend(Sc, T, pool.block_size,
+                                             pool.kv_dtype))
+        store, quantized = self._paged_storage_args(pool)
         # EXPLICIT upload of the per-call host arrays: the serving
         # decode loop runs under analysis.runtime.strict_step, where
         # an implicit numpy→device transfer at dispatch raises.
@@ -2119,8 +2460,11 @@ class ExportedModel(object):
             numpy.ascontiguousarray(chunk_lens, dtype=numpy.int32),
             numpy.ascontiguousarray(temps, dtype=numpy.float32),
             numpy.ascontiguousarray(seeds, dtype=numpy.uint32)))
-        ks, vs, tok0 = fn(self._lm_params(), ks, vs, *args)
-        pool.storage = (ks, vs)
+        ks, vs, sks, svs, tok0 = fn(
+            self._lm_params(), store[0], store[1],
+            store[2] if quantized else None,
+            store[3] if quantized else None, *args)
+        pool.storage = (ks, vs, sks, svs) if quantized else (ks, vs)
         return numpy.asarray(tok0)
 
     def paged_step(self, pool, tables, pos, tok, gen_idx, temps,
@@ -2133,9 +2477,11 @@ class ExportedModel(object):
         B, T = tables.shape
         fn = self.compile_cache.get_or_build(
             ("pstep", B, T, pool.n_blocks, pool.block_size,
+             pool.kv_dtype, self._decode_weight_mode(),
              self._decode_kernel_mode()),
-            lambda: self._build_paged_step(T, pool.block_size))
-        ks, vs = pool.storage
+            lambda: self._build_paged_step(T, pool.block_size,
+                                           pool.kv_dtype))
+        store, quantized = self._paged_storage_args(pool)
         # Explicit upload — see paged_extend (strict_step contract).
         args = jax.device_put((
             tables,
@@ -2144,8 +2490,11 @@ class ExportedModel(object):
             numpy.ascontiguousarray(gen_idx, dtype=numpy.int32),
             numpy.ascontiguousarray(temps, dtype=numpy.float32),
             numpy.ascontiguousarray(seeds, dtype=numpy.uint32)))
-        ks, vs, tok_new = fn(self._lm_params(), ks, vs, *args)
-        pool.storage = (ks, vs)
+        ks, vs, sks, svs, tok_new = fn(
+            self._lm_params(), store[0], store[1],
+            store[2] if quantized else None,
+            store[3] if quantized else None, *args)
+        pool.storage = (ks, vs, sks, svs) if quantized else (ks, vs)
         return numpy.asarray(tok_new)
 
     @staticmethod

@@ -636,8 +636,32 @@ def _decode_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
     lse_ref[0, 0, 0] = (m + jnp.log(l_safe))[:, 0]
 
 
+def _decode_kernel_quant(q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                         mask_ref, o_ref, lse_ref, *, scale, od):
+    """The quantized-pool twin of :func:`_decode_kernel`: the k/v
+    block arrives as stored codes (int8/fp8) plus a per-position
+    scale row, and the DEQUANT HAPPENS HERE in the gather — the
+    memory traffic is the quantized bytes, never a materialized f32
+    cache (the whole point of the quantized KV plane: decode is
+    bandwidth-bound, bytes are throughput)."""
+    q = q_ref[0, 0]
+    kb = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0][:, None]
+    vb = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0][:, None]
+    s = _dot(q, kb, od, trans_b=True) * scale
+    mask = mask_ref[0] != 0
+    s = jnp.where(mask, s, NEG_INF)
+    m = s.max(axis=1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask, p, 0.0)
+    l = p.sum(axis=1, keepdims=True)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0, 0] = (_dot(p, vb, od) / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0, 0] = (m + jnp.log(l_safe))[:, 0]
+
+
 def pallas_decode_attention(q, k, v, key_mask, block_k=None,
-                            operand_dtype=None, interpret=False):
+                            operand_dtype=None, interpret=False,
+                            k_scale=None, v_scale=None):
     """Flash-decode over a gathered key table: q (B, Sq, H, D) with
     Sq ≤ ``DECODE_MAX_Q``, k/v (B, L, H, D), ``key_mask`` (B, Sq, L)
     True = attend (the serving paths' per-row valid-slot masks —
@@ -646,7 +670,12 @@ def pallas_decode_attention(q, k, v, key_mask, block_k=None,
     (out, lse) and a cross-block lse merge combines them.  Forward
     only — decode never backpropagates.  Masked slots are exact
     zeros after the merge and real keys keep their relative order,
-    the same exactness argument as the dense paged path."""
+    the same exactness argument as the dense paged path.
+
+    ``k_scale``/``v_scale`` (B, L, H) engage the quantized-pool
+    variant: k/v are stored codes (int8/fp8) and each program
+    dequantizes its own block inside the kernel — ``codes · scale``
+    per position/head — so the HBM reads stay quantized-width."""
     if not supports_decode(q.shape, k.shape, interpret=interpret):
         raise ValueError(
             "geometry (%s × %s) outside the decode-kernel contract "
@@ -663,15 +692,34 @@ def pallas_decode_attention(q, k, v, key_mask, block_k=None,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     mask = key_mask.astype(jnp.int32)
+    quantized = k_scale is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, Sq, D), lambda b, h, j: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+        pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
+    ]
+    operands = [qt, kt, vt]
+    if quantized:
+        # (B, L, H) → (B, H, L): each program reads its block's
+        # per-position scale row next to the codes.
+        in_specs += [
+            pl.BlockSpec((1, 1, bk), lambda b, h, j: (b, h, j)),
+            pl.BlockSpec((1, 1, bk), lambda b, h, j: (b, h, j)),
+        ]
+        operands += [k_scale.transpose(0, 2, 1),
+                     v_scale.transpose(0, 2, 1)]
+        kernel = functools.partial(_decode_kernel_quant,
+                                   scale=scale, od=od)
+    else:
+        kernel = functools.partial(_decode_kernel, scale=scale,
+                                   od=od)
+    in_specs.append(
+        pl.BlockSpec((1, Sq, bk), lambda b, h, j: (b, 0, j)))
+    operands.append(mask)
     o_part, lse_part = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, od=od),
+        kernel,
         grid=(B, H, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, Sq, D), lambda b, h, j: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, j: (b, h, j, 0)),
-            pl.BlockSpec((1, Sq, bk), lambda b, h, j: (b, 0, j)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, 1, 1, Sq, D),
                          lambda b, h, j: (b, h, j, 0, 0)),
@@ -683,7 +731,7 @@ def pallas_decode_attention(q, k, v, key_mask, block_k=None,
             jax.ShapeDtypeStruct((B, H, nk, Sq), jnp.float32),
         ),
         interpret=interpret,
-    )(qt, kt, vt, mask)
+    )(*operands)
     # Cross-block lse merge (the flash-decode combine): weights are
     # exp(lse_i − lse_total) ≤ 1, void blocks weigh 0.
     lse = jax.nn.logsumexp(lse_part, axis=2)

@@ -80,9 +80,13 @@ class PopulationWorker(Workflow):
         place, so sharing them is safe)."""
         out = {}
         for name, state in ctx.items():
-            base, version = state or (None, None)
+            base, version, residual = (
+                tuple(state) + (None,) * (3 - len(state))
+            ) if state else (None, None, None)
             out[name] = (dict(base) if base is not None else None,
-                         version)
+                         version,
+                         dict(residual) if residual is not None
+                         else None)
         return out
 
     def _adopt_exploit(self, member, leader):

@@ -38,7 +38,7 @@ import numpy
 
 from .config import root
 from .error import Bug
-from .export import ExportedModel, export_workflow
+from .export import KV_DTYPES, ExportedModel, export_workflow
 from .http_common import JsonHttpServer, JsonRequestHandler
 from .resilience import Deadline
 from .serving import AdmissionError, RateLimiter, ServingEngine
@@ -82,6 +82,20 @@ def init_parser(parser):
     parser.add_argument(
         "--serve-kv-block-size", type=int, default=None, metavar="N",
         help="serving: tokens per paged KV cache block (default 16)")
+    parser.add_argument(
+        "--serve-kv-dtype", default=None, choices=KV_DTYPES,
+        help="serving: paged KV cache storage dtype (default f32); "
+             "int8/fp8 quantize per (block, head) with f32 scales "
+             "stored alongside the block tables — 4x the streams "
+             "per byte of HBM, token-level quality gated in tier-1")
+    parser.add_argument(
+        "--serve-weight-dtype", default=None,
+        choices=("f32", "int8"),
+        help="serving: decode-matmul weight storage (default f32); "
+             "int8 = weight-only quantization with per-output-"
+             "channel scales, dequantized inside the matmul — "
+             "training weights and the f32 parity oracle are "
+             "untouched")
     parser.add_argument(
         "--serve-no-paged", action="store_true",
         help="serving: disable paged decode-step batching and fall "
@@ -149,7 +163,7 @@ def serving_config_defaults():
     out = {}
     for key in ("max_batch", "queue_depth", "rate_limit", "deadline",
                 "token", "warmup", "kv_blocks", "kv_block_size",
-                "paged", "drain_timeout", "reload_watch",
+                "kv_dtype", "paged", "drain_timeout", "reload_watch",
                 "reload_poll", "spec", "spec_draft", "spec_max_k",
                 "spec_draft_blocks", "fabric_replicas",
                 "fabric_disagg", "tenant"):
@@ -190,6 +204,7 @@ class ModelServer(JsonHttpServer):
                  max_batch=8, queue_depth=64, rate_limit=None,
                  deadline=30.0, warmup=False, policy=None,
                  paged=None, kv_blocks=None, kv_block_size=16,
+                 kv_dtype=None,
                  drain_timeout=30.0, reload_watch=None,
                  reload_poll=5.0, spec=False, spec_draft=None,
                  spec_max_k=4, spec_draft_blocks=None,
@@ -210,6 +225,7 @@ class ModelServer(JsonHttpServer):
                 queue_depth=queue_depth, policy=policy,
                 default_deadline=deadline, paged=paged,
                 kv_blocks=kv_blocks, kv_block_size=kv_block_size,
+                kv_dtype=kv_dtype,
                 spec=spec, spec_draft=spec_draft,
                 spec_max_k=spec_max_k,
                 spec_draft_blocks=spec_draft_blocks,

@@ -19,6 +19,7 @@ import signal
 import sys
 import threading
 
+from .export import KV_DTYPES
 from .restful import ModelServer
 
 
@@ -62,6 +63,17 @@ def main(argv=None):
     parser.add_argument(
         "--kv-block-size", type=int, default=16, metavar="N",
         help="tokens per paged KV cache block (default 16)")
+    parser.add_argument(
+        "--kv-dtype", default=None, choices=KV_DTYPES,
+        help="paged KV cache storage dtype (default f32); int8/fp8 "
+             "quantize per (block, head) with f32 scales stored "
+             "alongside the block tables — 4x the streams per byte "
+             "of HBM (docs/serving.md 'Quantized KV')")
+    parser.add_argument(
+        "--weight-dtype", default=None, choices=("f32", "int8"),
+        help="decode-matmul weight storage (default f32); int8 = "
+             "weight-only quantization, per-output-channel scales "
+             "dequantized inside the matmul")
     parser.add_argument(
         "--no-paged-decode", action="store_true",
         help="disable paged decode-step continuous batching and "
@@ -122,6 +134,12 @@ def main(argv=None):
              "over-quota tenants get 429 + Retry-After — without "
              "shedding sibling tenants")
     args = parser.parse_args(argv)
+    if args.weight_dtype is not None:
+        # export.py reads the decode weight mode from config — the
+        # paged/bucketed programs re-quantize lazily on their next
+        # _lm_params() look.
+        from .config import root
+        root.common.serving.weight_dtype = args.weight_dtype
     server = ModelServer(
         args.artifact, host=args.host, port=args.port,
         token=args.token, max_batch=args.max_batch,
@@ -129,6 +147,7 @@ def main(argv=None):
         deadline=args.deadline, warmup=args.warmup,
         paged=False if args.no_paged_decode else None,
         kv_blocks=args.kv_blocks, kv_block_size=args.kv_block_size,
+        kv_dtype=args.kv_dtype,
         spec=args.spec, spec_draft=args.spec_draft,
         spec_max_k=args.spec_max_k,
         spec_draft_blocks=args.spec_draft_blocks,

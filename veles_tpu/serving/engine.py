@@ -141,6 +141,7 @@ class ServingEngine(Logger):
     def __init__(self, model, max_batch=8, queue_depth=64,
                  policy=None, stats=None, default_deadline=30.0,
                  paged=None, kv_blocks=None, kv_block_size=16,
+                 kv_dtype=None,
                  injector=None, max_replays=2, breaker_limit=3,
                  breaker_window=60.0, drain_timeout=30.0,
                  spec=False, spec_draft=None, spec_max_k=4,
@@ -154,6 +155,11 @@ class ServingEngine(Logger):
         self.default_deadline = default_deadline
         self.kv_block_size = int(kv_block_size)
         self.kv_blocks = kv_blocks
+        #: KV cache storage dtype ("f32"/"bf16"/"int8"/"fp8", None =
+        #: config / f32).  Passed through to ``make_kv_pool``; the
+        #: quantization itself lives entirely behind the paged
+        #: surface in export.py.
+        self.kv_dtype = kv_dtype
         self.kv_pool = None
         self._adopt_model(model, policy)
         #: Speculative decoding: "off" | "ngram" (prompt-lookup
@@ -284,14 +290,19 @@ class ServingEngine(Logger):
         if self.paged and self.kv_pool is None:
             n = self.kv_blocks or self._default_kv_blocks()
             self.kv_pool = self.model.make_kv_pool(
-                n, self.kv_block_size)
-            self.info("paged KV pool: %d blocks x %d slots "
-                      "(block 0 = trash)", n, self.kv_block_size)
+                n, self.kv_block_size, kv_dtype=self.kv_dtype)
+            self.info("paged KV pool: %d blocks x %d slots, "
+                      "storage %s (block 0 = trash)", n,
+                      self.kv_block_size, self.kv_pool.kv_dtype)
+            # e.g. quant.kv.int8 — which storage dtype this engine's
+            # pools were built with, visible next to the shed/usage
+            # counters it changes.
+            self.stats.incr("quant.kv.%s" % self.kv_pool.kv_dtype)
         if self.spec_mode == "draft" and self.draft_pool is None:
             n = self.spec_draft_blocks or self.kv_blocks or \
                 self._default_kv_blocks()
             self.draft_pool = self.draft_model.make_kv_pool(
-                n, self.kv_block_size)
+                n, self.kv_block_size, kv_dtype=self.kv_dtype)
             self.info("speculative draft pool: %d blocks x %d slots",
                       n, self.kv_block_size)
         return self.kv_pool
@@ -2178,8 +2189,8 @@ class ServingEngine(Logger):
                      "row(s)", error, len(all_rows))
         self.stats.incr("kv.pool.resets")
         self.stats.incr("breaker.rebuilds")
-        self.kv_pool = self.model.make_kv_pool(pool.n_blocks,
-                                               pool.block_size)
+        self.kv_pool = self.model.make_kv_pool(
+            pool.n_blocks, pool.block_size, kv_dtype=pool.kv_dtype)
         by_req = {}
         for row in all_rows:
             by_req.setdefault(row.req, []).append(row)
@@ -2311,6 +2322,8 @@ class ServingEngine(Logger):
         occ = pool.occupancy()
         self.stats.set_gauge("kv_blocks_used", occ["blocks_used"])
         self.stats.set_gauge("kv_blocks_total", occ["blocks_total"])
+        self.stats.set_gauge("kv_bytes_used", occ["bytes_used"])
+        self.stats.set_gauge("kv_bytes_total", occ["bytes_total"])
         self.stats.set_gauge("decode_rows", len(self._rows))
 
     # -- warmup ------------------------------------------------------------

@@ -258,7 +258,8 @@ def live_serving_summary():
         # Degraded state leads the row: a rebuilding/tripped breaker
         # is exactly what the operator opened the dashboard for.
         out["breaker"] = sorted(breakers - {"closed"})[0]
-    used = total = 0
+    used = total = bytes_used = bytes_total = 0
+    dtypes = set()
     for e in engines:
         pool = getattr(e, "kv_pool", None)
         if pool is None:
@@ -266,7 +267,18 @@ def live_serving_summary():
         occ = pool.occupancy()
         used += occ["blocks_used"]
         total += occ["blocks_total"]
+        bytes_used += occ.get("bytes_used", 0)
+        bytes_total += occ.get("bytes_total", 0)
+        dtypes.add(occ.get("storage_dtype", "f32"))
     if total:
         out["kv_blocks_used"] = used
         out["kv_blocks_total"] = total
+        if bytes_total:
+            # The byte figures make the quantized-pool win visible
+            # on the dashboard: same block count, a fraction of the
+            # HBM.
+            out["kv_bytes_used"] = bytes_used
+            out["kv_bytes_total"] = bytes_total
+        if dtypes:
+            out["kv_dtype"] = "/".join(sorted(dtypes))
     return out

@@ -156,6 +156,12 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
         self._synced_ = {}           # delta: slave -> (version, arrays)
         self._base_ = None           # worker: last synced arrays
         self._base_version_ = None
+        # Error-feedback plane for the lossy int8 wire: per-attr f32
+        # quantization error of the LAST shipped delta, added back
+        # into the next one before it is quantized — the master
+        # eventually receives every gradient bit, just a sync late,
+        # which is what keeps int8-delta training converging.
+        self._residual_ = {}
 
     def _trainable_arrays(self):
         import numpy
@@ -218,6 +224,10 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
         from ..resilience import ProtocolError
         if "F" in data:
             self._base_ = {}
+            # A full rebase starts a fresh delta session: any owed
+            # quantization error was relative to the old base and
+            # must not leak into the new one.
+            self._residual_ = {}
             for attr, arr in data["F"].items():
                 vec = self.trainables.get(attr)
                 if vec is not None:
@@ -274,8 +284,10 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
         proto = self._net_proto()
         if not proto.get("delta") or self._base_ is None:
             return arrays
-        from ..network_common import encode_bf16
-        bf16 = proto.get("dtype") == "bf16"
+        import zlib
+        from ..network_common import encode_delta, decode_delta
+        dtype = proto.get("dtype") or "fp32"
+        feedback = dtype == "int8"
         delta = {}
         for attr, arr in arrays.items():
             b = self._base_.get(attr)
@@ -287,11 +299,31 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
                 # collapse to a None marker, mirroring the
                 # master→worker direction — with codec=none a dense
                 # zero delta would ship full-weights-sized payloads.
+                # Any error-feedback residual stays parked and rides
+                # the next REAL update instead of shipping alone.
                 delta[attr] = None
                 continue
-            if bf16 and d.dtype == "float32":
-                d = {"b16": encode_bf16(d)}
-            delta[attr] = d
+            if feedback and d.dtype == "float32":
+                r = self._residual_.get(attr)
+                if r is not None and r.shape == d.shape:
+                    d = d + r
+            # Deterministic stochastic-rounding seed: the same
+            # (tensor, base version) quantizes identically on every
+            # replay, so seeded loopback sessions stay reproducible.
+            seed = zlib.crc32(attr.encode("utf-8")) ^ \
+                ((self._base_version_ or 0) & 0xFFFFFFFF)
+            payload = encode_delta(d, dtype, seed=seed)
+            if payload is None:
+                # Exact-f32 rung (or a codec refusal, e.g. a
+                # non-finite delta int8 cannot carry): nothing is
+                # lost, so nothing is owed.
+                if feedback:
+                    self._residual_.pop(attr, None)
+                delta[attr] = d
+                continue
+            if feedback:
+                self._residual_[attr] = d - decode_delta(payload)
+            delta[attr] = payload
         return {"U": delta, "bv": self._base_version_}
 
     def apply_data_from_slave(self, data, slave=None):
@@ -303,13 +335,12 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
         if not data:
             return
         if "U" in data:
-            from ..network_common import decode_bf16
+            from ..network_common import decode_delta
             for attr, d in data["U"].items():
                 vec = self.trainables.get(attr)
                 if vec is None or d is None:  # None = unchanged
                     continue
-                if isinstance(d, dict) and "b16" in d:
-                    d = decode_bf16(d["b16"])
+                d = decode_delta(d)
                 vec.map_read()  # device copy (if any) is not newer
                 vec.mem = vec.mem + d.reshape(vec.mem.shape)
             return
@@ -340,12 +371,18 @@ class ForwardBase(TracedUnit, metaclass=ForwardUnitRegistry):
         on one worker never cross-apply a delta against a sibling's
         base.  Arrays are rebound, never mutated in place, so the
         snapshot stays valid while another member is installed."""
-        return (self._base_, self._base_version_)
+        return (self._base_, self._base_version_, dict(self._residual_))
 
     def import_sync_state(self, state):
         """Worker side: installs a member's delta-session base
-        (``None`` state = fresh member, forces a full-ship sync)."""
-        self._base_, self._base_version_ = state or (None, None)
+        (``None`` state = fresh member, forces a full-ship sync).
+        Accepts pre-int8 two-tuples (no error-feedback residual
+        plane) from older snapshots."""
+        state = state or (None, None, {})
+        if len(state) == 2:
+            state = state + ({},)
+        self._base_, self._base_version_, residual = state
+        self._residual_ = dict(residual or {})
 
     def adopt_synced_from(self, src, slave):
         """Master side, exploit-as-delta (docs/population.md): seeds
@@ -896,8 +933,11 @@ class GradientDescentBase(TracedUnit, metaclass=GDUnitRegistry):
         return (self._slot_base_, self._slot_base_version_)
 
     def import_sync_state(self, state):
+        # Slot deltas always ship exact (fp32/bf16 rungs only), so a
+        # context copied through the 3-tuple weight-state shape just
+        # drops its (always-None) residual slot here.
         self._slot_base_, self._slot_base_version_ = \
-            state or (None, None)
+            tuple(state)[:2] if state else (None, None)
 
     def adopt_synced_from(self, src, slave):
         """Master side: exploit-as-delta for the slot shards (see
